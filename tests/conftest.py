@@ -1,0 +1,39 @@
+"""Test env: force the CPU backend with 8 virtual devices BEFORE jax loads,
+so mesh/sharding tests exercise real collectives without TPU hardware
+(SURVEY.md §4's prescribed strategy)."""
+
+import os
+
+# Force CPU even when the ambient env selects a TPU platform (e.g. axon):
+# tests must not occupy the real chip and need 8 virtual devices. The env
+# vars alone are not enough here because a sitecustomize may import jax at
+# interpreter startup (latching JAX_PLATFORMS) — jax.config.update still
+# works as long as no backend has been initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults_and_metrics():
+    from tfidf_tpu.utils.faults import global_injector
+    from tfidf_tpu.utils.metrics import global_metrics
+    yield
+    global_injector.disarm()
+    global_injector.fired.clear()
+    global_metrics.reset()

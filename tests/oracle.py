@@ -1,0 +1,79 @@
+"""Independent numpy reference implementations (golden oracles).
+
+Deliberately written as naive per-document loops — structurally unlike the
+chunked/segment-sum device kernels they validate — so a shared bug is
+unlikely. BM25 follows Lucene 9 BM25Similarity (idf = ln(1+(N-df+0.5)/
+(df+0.5)), no (k1+1) numerator); TF-IDF follows the smoothed-idf scheme
+documented in tfidf_tpu.ops.scoring.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def df_of(docs: list[dict[int, int]]) -> dict[int, int]:
+    df: dict[int, int] = {}
+    for d in docs:
+        for t in d:
+            df[t] = df.get(t, 0) + 1
+    return df
+
+
+def bm25_scores(docs: list[dict[int, int]], lengths: list[float],
+                query: dict[int, float], *, k1: float = 1.2,
+                b: float = 0.75, n_docs: float | None = None,
+                df: dict[int, int] | None = None,
+                avgdl: float | None = None) -> list[float]:
+    n = float(len(docs) if n_docs is None else n_docs)
+    df = df_of(docs) if df is None else df
+    if avgdl is None:
+        avgdl = sum(lengths) / max(len(lengths), 1)
+    out = []
+    for d, dl in zip(docs, lengths):
+        s = 0.0
+        for t, qw in query.items():
+            tf = d.get(t, 0)
+            if tf == 0:
+                continue
+            idf = math.log(1.0 + (n - df.get(t, 0) + 0.5)
+                           / (df.get(t, 0) + 0.5))
+            s += qw * idf * tf / (tf + k1 * (1 - b + b * dl / avgdl))
+        out.append(s)
+    return out
+
+
+def tfidf_scores(docs: list[dict[int, int]], query: dict[int, float],
+                 *, n_docs: float | None = None,
+                 df: dict[int, int] | None = None,
+                 cosine: bool = False) -> list[float]:
+    n = float(len(docs) if n_docs is None else n_docs)
+    df = df_of(docs) if df is None else df
+
+    def idf(t: int) -> float:
+        return math.log((1.0 + n) / (1.0 + df.get(t, 0))) + 1.0
+
+    out = []
+    for d in docs:
+        s = sum(qw * d.get(t, 0) * idf(t) for t, qw in query.items())
+        if cosine:
+            norm = math.sqrt(sum((tf * idf(t)) ** 2 for t, tf in d.items()))
+            s = s / norm if norm > 0 else 0.0
+        out.append(s)
+    return out
+
+
+def random_corpus(rng, n_docs: int, vocab: int, max_len: int = 60,
+                  zipf_a: float = 1.3) -> tuple[list[dict[int, int]],
+                                                list[float]]:
+    """Zipfian synthetic corpus: returns (term->tf maps, analyzed lengths)."""
+    docs, lengths = [], []
+    for _ in range(n_docs):
+        length = int(rng.integers(1, max_len))
+        terms = rng.zipf(zipf_a, size=length) % vocab
+        counts: dict[int, int] = {}
+        for t in terms:
+            counts[int(t)] = counts.get(int(t), 0) + 1
+        docs.append(counts)
+        lengths.append(float(length))
+    return docs, lengths

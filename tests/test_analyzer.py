@@ -1,0 +1,67 @@
+from tfidf_tpu.ops.analyzer import Analyzer, extract_text, make_analyzer
+
+
+def test_basic_tokens():
+    a = Analyzer()
+    assert a.tokens("The quick Brown-Fox jumps!") == \
+        ["the", "quick", "brown", "fox", "jumps"]
+
+
+def test_apostrophe_stays_one_token():
+    # UAX#29 MidLetter rule, as StandardTokenizer does
+    assert Analyzer().tokens("can't won't") == ["can't", "won't"]
+
+
+def test_numbers_with_separators():
+    assert Analyzer().tokens("pi is 3.14 and 1,000 units") == \
+        ["pi", "is", "3.14", "and", "1,000", "units"]
+
+
+def test_no_stopwords_by_default():
+    # Lucene 9 StandardAnalyzer() has an EMPTY default stop set
+    assert "the" in Analyzer().tokens("the cat")
+
+
+def test_stopword_filter():
+    a = make_analyzer(stopwords=["the", "a"])
+    assert a.tokens("the cat sat on a mat") == ["cat", "sat", "on", "mat"]
+
+
+def test_case_folding_off():
+    a = Analyzer(lowercase=False)
+    assert a.tokens("Fast Food") == ["Fast", "Food"]
+
+
+def test_long_token_split_not_dropped():
+    a = Analyzer(max_token_length=10)
+    toks = a.tokens("x" * 25)
+    assert toks == ["x" * 10, "x" * 10, "x" * 5]
+
+
+def test_counts():
+    assert Analyzer().counts("fast food fast") == {"fast": 2, "food": 1}
+
+
+def test_unicode_tokens():
+    assert Analyzer().tokens("café müller") == ["café", "müller"]
+
+
+def test_extract_utf8():
+    assert extract_text("héllo wörld".encode("utf-8")) == "héllo wörld"
+
+
+def test_extract_latin1_fallback():
+    data = "héllo".encode("latin-1")  # invalid as UTF-8
+    assert "h" in extract_text(data) and "llo" in extract_text(data)
+
+
+def test_extract_utf16_bom():
+    data = "hello world".encode("utf-16")
+    assert extract_text(data) == "hello world"
+
+
+def test_extract_binary_degrades():
+    noise = bytes(range(256)) * 4
+    text = extract_text(noise)
+    # control bytes become spaces; no exception, tokenizable output
+    assert isinstance(text, str)

@@ -1,0 +1,75 @@
+import pytest
+
+from tests.test_engine import CORPUS, ingest_corpus, make_engine
+from tfidf_tpu.engine.checkpoint import load_checkpoint, save_checkpoint
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.faults import FaultInjected, global_injector
+
+
+def test_save_load_roundtrip(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    want = e.search("fast food", k=5)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+    e2 = load_checkpoint(ckpt, e.config)
+    got = e2.search("fast food", k=5)
+    assert [(h.name, round(h.score, 5)) for h in want] == \
+        [(h.name, round(h.score, 5)) for h in got]
+    assert len(e2.vocab) == len(e.vocab)
+
+
+def test_checkpoint_then_incremental_ingest(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+    e2 = load_checkpoint(ckpt, e.config)
+    e2.ingest_text("new.txt", "fresh fast document")
+    e2.commit()
+    names = [h.name for h in e2.search("fast", k=10)]
+    assert "new.txt" in names and "file1.txt" in names
+
+
+def test_checkpoint_overwrite_is_atomic(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+    # second save crashes before publish — old checkpoint must survive
+    e.ingest_text("extra.txt", "more fast content")
+    e.commit()
+    global_injector.arm("checkpoint.pre_publish", "raise")
+    with pytest.raises(FaultInjected):
+        save_checkpoint(e, ckpt)
+    global_injector.disarm()
+    e2 = load_checkpoint(ckpt, e.config)
+    assert e2.index.num_live_docs == len(CORPUS)   # pre-crash state
+
+
+def test_load_respects_model_in_meta(tmp_path):
+    cfg = Config(model="tfidf", min_nnz_capacity=64, min_doc_capacity=8,
+                 min_vocab_capacity=32,
+                 documents_path=str(tmp_path / "d"))
+    e = make_engine(tmp_path, model="tfidf")
+    ingest_corpus(e)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+    e2 = load_checkpoint(ckpt, cfg.replace(model="bm25"))
+    assert e2.model.kind == "tfidf"
+
+
+def test_repeated_saves_prune_versions(tmp_path):
+    import os
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    ckpt = str(tmp_path / "ckpt")
+    for i in range(3):
+        e.ingest_text(f"extra{i}.txt", "more content")
+        e.commit()
+        save_checkpoint(e, ckpt)
+    assert os.path.islink(ckpt)
+    versions = [d for d in os.listdir(tmp_path) if d.startswith("ckpt.v")]
+    assert len(versions) == 1          # superseded versions pruned
+    e2 = load_checkpoint(ckpt, e.config)
+    assert e2.index.num_live_docs == len(CORPUS) + 3

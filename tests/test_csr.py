@@ -1,0 +1,53 @@
+import numpy as np
+
+from tfidf_tpu.ops.csr import (CooShard, build_coo, merge_coo, next_capacity,
+                               widen_vocab)
+
+
+def test_next_capacity():
+    assert next_capacity(0, 16) == 16
+    assert next_capacity(16, 16) == 16
+    assert next_capacity(17, 16) == 32
+    assert next_capacity(1000, 16) == 1024
+
+
+def test_build_coo_contents():
+    docs = [{1: 2, 3: 1}, {}, {3: 4}]
+    s = build_coo(docs, vocab_cap=8, min_nnz_cap=4, min_doc_cap=4)
+    assert s.nnz == 3 and s.num_docs == 3
+    assert s.tf[:3].tolist() == [2.0, 1.0, 4.0]
+    assert s.term[:3].tolist() == [1, 3, 3]
+    assert s.doc[:3].tolist() == [0, 0, 2]
+    assert s.doc_len[:3].tolist() == [3.0, 0.0, 4.0]
+    assert s.df.tolist() == [0, 1, 0, 2, 0, 0, 0, 0]
+    # padding is inert: zero tf beyond nnz
+    assert s.tf[3:].sum() == 0
+
+
+def test_row_sorted():
+    docs = [{i: 1, i + 1: 2} for i in range(10)]
+    s = build_coo(docs, vocab_cap=16, min_nnz_cap=4, min_doc_cap=4)
+    rows = s.doc[:s.nnz]
+    assert (np.diff(rows) >= 0).all()
+
+
+def test_merge_coo():
+    a = build_coo([{0: 1}, {1: 2}], vocab_cap=4, min_nnz_cap=4, min_doc_cap=4)
+    b = build_coo([{1: 3}], vocab_cap=4, min_nnz_cap=4, min_doc_cap=4)
+    m = merge_coo([a, b], vocab_cap=4, min_nnz_cap=4, min_doc_cap=4)
+    assert m.nnz == 3 and m.num_docs == 3
+    assert m.doc[:3].tolist() == [0, 1, 2]   # renumbered
+    assert m.df.tolist() == [1, 2, 0, 0]
+    assert m.doc_len[:3].tolist() == [1.0, 2.0, 3.0]
+
+
+def test_widen_vocab():
+    a = build_coo([{0: 1}], vocab_cap=4, min_nnz_cap=4, min_doc_cap=4)
+    w = widen_vocab(a, 16)
+    assert w.vocab_cap == 16 and w.df[:4].tolist() == a.df.tolist()
+    assert widen_vocab(a, 2) is a
+
+
+def test_size_bytes_positive():
+    a = build_coo([{0: 1}], vocab_cap=4, min_nnz_cap=4, min_doc_cap=4)
+    assert a.size_bytes() > 0

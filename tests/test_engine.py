@@ -1,0 +1,201 @@
+import os
+
+import numpy as np
+import pytest
+
+from tests.oracle import bm25_scores
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.utils.config import Config
+
+# The reference's own dev corpus themes (src/main/resources/documents/)
+CORPUS = {
+    "file1.txt": "fast food is fast and cheap",
+    "file2.txt": "the cat meowing at night causes trouble",
+    "file3.txt": "fast cars go very fast on the road",
+    "file4.txt": "cheap food for the cat",
+    "file5.txt": "night driving in fast cars",
+}
+
+
+def make_engine(tmp_path, **kw):
+    cfg = Config(documents_path=str(tmp_path / "docs"),
+                 index_path=str(tmp_path / "index"),
+                 min_nnz_capacity=64, min_doc_capacity=8,
+                 min_vocab_capacity=32, **kw)
+    return Engine(cfg)
+
+
+def ingest_corpus(engine):
+    for name, text in CORPUS.items():
+        engine.ingest_text(name, text)
+    engine.commit()
+
+
+def test_search_end_to_end(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    hits = e.search("fast food", k=5)
+    names = [h.name for h in hits]
+    assert "file1.txt" in names       # has both terms, twice "fast"
+    assert names[0] == "file1.txt"
+    assert all(h.score > 0 for h in hits)
+    # docs with neither term don't appear
+    assert "file2.txt" not in names
+
+
+def test_search_matches_oracle(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    hits = dict(e.search("fast food", k=5))
+    # independent computation
+    docs, lengths, names = [], [], []
+    for name, text in CORPUS.items():
+        counts = e.analyzer.counts(text)
+        ids = {e.vocab.lookup(t): c for t, c in counts.items()}
+        docs.append(ids)
+        lengths.append(float(sum(counts.values())))
+        names.append(name)
+    q = {e.vocab.lookup("fast"): 1.0, e.vocab.lookup("food"): 1.0}
+    want = bm25_scores(docs, lengths, q)
+    for name, score in hits.items():
+        np.testing.assert_allclose(score, want[names.index(name)], rtol=1e-4)
+
+
+def test_batch_search(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    res = e.search_batch(["fast", "cat", "zebra"], k=3)
+    assert len(res) == 3
+    assert res[0] and res[1]
+    assert res[2] == []               # unknown term matches nothing
+
+
+def test_upsert_idempotent(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    before = e.search("fast food", k=5)
+    # re-ingest same docs (the boot-time re-walk does this)
+    ingest_corpus(e)
+    after = e.search("fast food", k=5)
+    assert [(h.name, round(h.score, 5)) for h in before] == \
+        [(h.name, round(h.score, 5)) for h in after]
+    assert e.index.num_live_docs == len(CORPUS)
+
+
+def test_upsert_replaces_content(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    e.ingest_text("file2.txt", "completely different subject now")
+    e.commit()
+    names = [h.name for h in e.search("cat", k=5)]
+    assert "file2.txt" not in names
+    names = [h.name for h in e.search("subject", k=5)]
+    assert names == ["file2.txt"]
+
+
+def test_delete(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    assert e.delete("file1.txt")
+    assert not e.delete("file1.txt")
+    e.commit()
+    assert "file1.txt" not in [h.name for h in e.search("fast", k=5)]
+    assert e.index.num_live_docs == len(CORPUS) - 1
+
+
+def test_unbounded_returns_all_matches(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    hits = e.search("fast", k=1, unbounded=True)
+    fast_docs = [n for n, t in CORPUS.items() if "fast" in t]
+    assert sorted(h.name for h in hits) == sorted(fast_docs)
+
+
+def test_empty_index_search(tmp_path):
+    e = make_engine(tmp_path)
+    assert e.search("anything") == []
+    e.commit()
+    assert e.search("anything") == []
+
+
+def test_build_from_directory_and_download(tmp_path):
+    docs_dir = tmp_path / "docs" / "sub"
+    docs_dir.mkdir(parents=True)
+    (tmp_path / "docs" / "a.txt").write_text("fast food here")
+    (docs_dir / "b.txt").write_text("slow food there")
+    e = make_engine(tmp_path)
+    n = e.build_from_directory()
+    assert n == 2
+    names = [h.name for h in e.search("food", k=5)]
+    assert sorted(names) == ["a.txt", os.path.join("sub", "b.txt")]
+    # download path + traversal safety (Worker.java:97-121 semantics)
+    assert b"fast food here" == e.open_document("a.txt")
+    assert e.open_document("missing.txt") is None
+    with pytest.raises(PermissionError):
+        e.open_document("../outside.txt")
+
+
+def test_ingest_bytes_saves_to_disk(tmp_path):
+    e = make_engine(tmp_path)
+    e.ingest_bytes("x/y.txt", b"hello fast world", save_to_disk=True)
+    e.commit()
+    assert (tmp_path / "docs" / "x" / "y.txt").read_bytes() == \
+        b"hello fast world"
+    assert [h.name for h in e.search("hello")] == ["x/y.txt"]
+
+
+def test_index_size_grows(tmp_path):
+    e = make_engine(tmp_path)
+    e.ingest_text("a", "one two three")
+    e.commit()
+    s1 = e.index_size_bytes()
+    for i in range(50):
+        e.ingest_text(f"doc{i}", f"word{i} " * 30)
+    e.commit()
+    assert e.index_size_bytes() >= s1
+
+
+def test_snapshot_reuse_when_clean(tmp_path):
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    v1 = e.index.snapshot.version
+    e.commit()   # nothing changed
+    assert e.index.snapshot.version == v1
+
+
+def test_lucene_parity_mode_still_ranks(tmp_path):
+    e = make_engine(tmp_path, lucene_parity=True)
+    ingest_corpus(e)
+    hits = e.search("fast food", k=5)
+    assert hits and hits[0].name == "file1.txt"
+
+
+def test_result_order_name_parity_mode(tmp_path):
+    """result_order="name" reproduces Leader.java:80-91 alphabetical order."""
+    e = make_engine(tmp_path, result_order="name")
+    ingest_corpus(e)
+    hits = e.search("fast food", k=10)
+    assert [h.name for h in hits] == sorted(h.name for h in hits)
+
+
+def test_commit_not_lost_on_interleaved_write(tmp_path):
+    """A write landing during commit() must leave the index dirty so the
+    next commit picks it up (generation-counter semantics)."""
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    orig_to_coo = e.index.to_coo
+
+    def racing_to_coo(vocab_cap):
+        out = orig_to_coo(vocab_cap)
+        # a concurrent writer sneaks in after the snapshot build read state
+        e.index.add_document("raced.txt", {0: 1}, length=1.0)
+        return out
+
+    e.index.to_coo = racing_to_coo
+    e.ingest_text("trigger.txt", "fast trigger")
+    e.index.commit(e.vocab.capacity())
+    e.index.to_coo = orig_to_coo
+    assert "raced.txt" not in e.index.snapshot.doc_names
+    # the raced write is NOT silently lost: next commit includes it
+    e.index.commit(e.vocab.capacity())
+    assert "raced.txt" in e.index.snapshot.doc_names

@@ -1,0 +1,61 @@
+import numpy as np
+import pytest
+
+from tfidf_tpu.models.base import get_model
+from tfidf_tpu.models.bm25 import (BM25Model, byte4_to_int, int_to_byte4,
+                                   quantize_length, quantize_lengths)
+
+
+def test_byte4_roundtrip_small_exact():
+    # SmallFloat byte4 represents small ints exactly (the free values)
+    for i in range(40):
+        assert byte4_to_int(int_to_byte4(i)) == i
+
+
+def test_byte4_monotone():
+    prev = -1
+    for i in range(0, 100000, 7):
+        enc = int_to_byte4(i)
+        assert 0 <= enc <= 255
+        dec = byte4_to_int(enc)
+        assert dec <= i          # truncation, never rounds up
+        assert dec >= prev
+        prev = dec
+
+
+def test_byte4_idempotent():
+    for i in [0, 1, 39, 40, 100, 1000, 123456, 10**9]:
+        q = quantize_length(i)
+        assert quantize_length(q) == q
+
+
+def test_quantize_lengths_vectorized_matches_scalar():
+    vals = np.array([0, 1, 5, 39, 40, 41, 100, 999, 12345, 10**6])
+    vec = quantize_lengths(vals)
+    for v, q in zip(vals, vec):
+        assert q == quantize_length(int(v))
+
+
+def test_bm25_parity_transform():
+    m = BM25Model(lucene_parity=True)
+    out = m.transform_doc_len(np.array([100.0, 3.0], np.float32))
+    assert out.dtype == np.float32
+    assert out[1] == 3.0
+    assert out[0] <= 100.0
+    m2 = BM25Model(lucene_parity=False)
+    np.testing.assert_array_equal(
+        m2.transform_doc_len(np.array([100.0])), [100.0])
+
+
+def test_get_model():
+    assert get_model("bm25").kind == "bm25"
+    assert get_model("tfidf").kind == "tfidf"
+    assert get_model("tfidf_cosine").needs_norms
+    assert not get_model("bm25").needs_norms
+    with pytest.raises(ValueError):
+        get_model("nope")
+
+
+def test_query_weights_multiplicity():
+    m = get_model("bm25")
+    assert m.query_weights({3: 2, 5: 1}) == {3: 2.0, 5: 1.0}

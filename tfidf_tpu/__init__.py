@@ -1,0 +1,32 @@
+"""tfidf_tpu — a TPU-native distributed full-text search framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+system kheder-hassoun/Tf-IDF-Distributed-System (a Spring Boot + ZooKeeper +
+Lucene distributed TF-IDF search engine, see /root/reference): document
+ingest with idempotent upsert, sharded indexing, scatter-gather query
+scoring, load-balanced uploads, membership/liveness, leader failover,
+checkpoint/resume — re-designed TPU-first:
+
+- the per-worker Lucene index (reference ``worker/Worker.java:54-94``)
+  becomes a CSR term-document matrix resident on TPU devices;
+- query scoring (``Worker.java:222-241``) becomes a batched sparse-dense
+  contraction with exact top-k on the MXU/VPU;
+- the leader's scatter-gather + score merge (``leader/Leader.java:39-92``)
+  becomes ``shard_map`` collectives (``psum`` for global document frequency
+  and score reduction, ``all_gather`` for distributed top-k) over a
+  ``jax.sharding.Mesh``;
+- ZooKeeper election/registry (``leader/LeaderElection.java``,
+  ``registry/ServiceRegistry.java``) becomes a small coordination service
+  with the same znode semantics (ephemeral-sequential nodes, one-shot
+  watches) driving an HTTP control plane.
+
+Subpackages:
+    ops       pure-JAX/Pallas compute: analyzer, CSR, scoring, top-k
+    models    scoring model families: TF-IDF variants, Lucene-parity BM25
+    parallel  mesh construction + sharded scoring collectives
+    engine    host-side index: vocabulary, segments, checkpoints, searcher
+    cluster   control plane: coordination, election, registry, HTTP nodes
+    utils     config, structured logging, metrics, tracing, fault injection
+"""
+
+__version__ = "0.1.0"

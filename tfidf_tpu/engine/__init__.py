@@ -1,0 +1,16 @@
+from tfidf_tpu.engine.vocab import Vocabulary
+from tfidf_tpu.engine.index import ShardIndex, Snapshot
+from tfidf_tpu.engine.searcher import Searcher, SearchHit
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.engine.checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = [
+    "Vocabulary",
+    "ShardIndex",
+    "Snapshot",
+    "Searcher",
+    "SearchHit",
+    "Engine",
+    "save_checkpoint",
+    "load_checkpoint",
+]

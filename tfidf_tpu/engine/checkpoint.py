@@ -1,0 +1,129 @@
+"""Durable checkpoint of a shard index (postings + vocabulary).
+
+The reference's "checkpoint" is its Lucene index directory on a persistent
+volume, committed after boot and after every upload (``Worker.java:88,138``);
+resume is a re-walk of the raw documents with idempotent upserts. We keep
+that property — ``Engine.build_from_directory`` always works — and add an
+explicit, atomic checkpoint that restores the exact index state (postings,
+lengths, vocabulary, ingest order) much faster than re-analyzing the corpus.
+
+Format: ``<path>`` is a symlink to a versioned sibling ``<path>.v<N>``
+containing:
+    vocab.txt    one term per line, line number = id
+    docs.npz     offsets[n+1], term_ids[nnz], tfs[nnz], lengths[n]
+    names.json   document names, aligned with offsets
+    meta.json    model kind, counts, format version
+
+Publish is a single atomic ``os.replace`` of the symlink, so at every
+instant ``<path>`` resolves to a complete checkpoint — a crash anywhere in
+``save_checkpoint`` leaves the previous one intact and loadable. Older
+``.v<N>`` dirs are pruned only after a successful publish.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import numpy as np
+
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.engine.vocab import Vocabulary
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.faults import fault_point
+from tfidf_tpu.utils.logging import get_logger
+
+log = get_logger("engine.checkpoint")
+
+FORMAT_VERSION = 1
+
+
+def save_checkpoint(engine: Engine, directory: str) -> None:
+    entries = engine.index.live_entries()
+    n = len(entries)
+    offsets = np.zeros(n + 1, np.int64)
+    for i, d in enumerate(entries):
+        offsets[i + 1] = offsets[i] + d.term_ids.shape[0]
+    nnz = int(offsets[-1])
+    term_ids = np.zeros(nnz, np.int32)
+    tfs = np.zeros(nnz, np.float32)
+    lengths = np.zeros(n, np.float32)
+    for i, d in enumerate(entries):
+        term_ids[offsets[i]:offsets[i + 1]] = d.term_ids
+        tfs[offsets[i]:offsets[i + 1]] = d.tfs
+        lengths[i] = d.length
+
+    base = directory.rstrip("/")
+    parent = os.path.dirname(os.path.abspath(base)) or "."
+    os.makedirs(parent, exist_ok=True)
+    prefix = os.path.basename(base) + ".v"
+    existing = sorted(int(d[len(prefix):]) for d in os.listdir(parent)
+                      if d.startswith(prefix)
+                      and d[len(prefix):].isdigit())
+    version = (existing[-1] + 1) if existing else 1
+    vdir = f"{base}.v{version}"
+    if os.path.exists(vdir):
+        shutil.rmtree(vdir)
+    os.makedirs(vdir)
+    engine.vocab.save(os.path.join(vdir, "vocab.txt"))
+    np.savez(os.path.join(vdir, "docs.npz"),
+             offsets=offsets, term_ids=term_ids, tfs=tfs, lengths=lengths)
+    with open(os.path.join(vdir, "names.json"), "w", encoding="utf-8") as f:
+        json.dump([d.name for d in entries], f)
+    with open(os.path.join(vdir, "meta.json"), "w", encoding="utf-8") as f:
+        json.dump({
+            "format_version": FORMAT_VERSION,
+            "model": engine.model.kind,
+            "num_docs": n,
+            "nnz": nnz,
+            "vocab_size": len(engine.vocab),
+        }, f)
+    fault_point("checkpoint.pre_publish")   # crash window for fault tests
+    # Atomic publish: swing the symlink in one os.replace. <base> always
+    # resolves to a complete checkpoint, before and after.
+    link_tmp = f"{base}.lnk.tmp"
+    if os.path.lexists(link_tmp):
+        os.remove(link_tmp)
+    os.symlink(os.path.basename(vdir), link_tmp)
+    if os.path.isdir(base) and not os.path.islink(base):
+        # migrate a pre-symlink-format checkpoint out of the way first
+        os.rename(base, f"{base}.v0")
+        existing.insert(0, 0)
+    os.replace(link_tmp, base)
+    # prune superseded versions only after a successful publish
+    for v in existing:
+        shutil.rmtree(f"{base}.v{v}", ignore_errors=True)
+    log.info("checkpoint saved", dir=directory, docs=n, nnz=nnz,
+             version=version)
+
+
+def load_checkpoint(directory: str, config: Config | None = None) -> Engine:
+    with open(os.path.join(directory, "meta.json"), encoding="utf-8") as f:
+        meta = json.load(f)
+    if meta["format_version"] != FORMAT_VERSION:
+        raise ValueError(f"unknown checkpoint format {meta['format_version']}")
+    config = config or Config()
+    if meta["model"] != config.model:
+        config = config.replace(model=meta["model"])
+    engine = Engine(config)
+    engine.vocab = Vocabulary.load(os.path.join(directory, "vocab.txt"),
+                                   min_capacity=config.min_vocab_capacity)
+    engine.searcher.vocab = engine.vocab
+    data = np.load(os.path.join(directory, "docs.npz"))
+    with open(os.path.join(directory, "names.json"), encoding="utf-8") as f:
+        names = json.load(f)
+    offsets = data["offsets"]
+    term_ids = data["term_ids"]
+    tfs = data["tfs"]
+    lengths = data["lengths"]
+    for i, name in enumerate(names):
+        lo, hi = int(offsets[i]), int(offsets[i + 1])
+        engine.index.add_document(
+            name,
+            dict(zip(term_ids[lo:hi].tolist(),
+                     tfs[lo:hi].astype(np.int64).tolist())),
+            length=float(lengths[i]))
+    engine.commit()
+    log.info("checkpoint loaded", dir=directory, docs=len(names))
+    return engine

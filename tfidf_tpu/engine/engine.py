@@ -1,0 +1,134 @@
+"""Engine — the per-node facade tying analyzer, vocab, index, and searcher.
+
+One Engine is what a worker node hosts (the role of the whole Lucene +
+filesystem stack inside ``worker/Worker.java``): ingest bytes -> text ->
+tokens -> vocab ids -> shard index; commit; search; checkpoint; rebuild.
+
+Durability model matches the reference exactly (SURVEY.md §5.4): raw
+documents on disk are the source of truth (``${mydocument.path}``); the
+index is always reconstructible from them by ``build_from_directory`` (the
+boot-time re-walk of ``Worker.java:77-88``); checkpoints are an optimization
+over that rebuild, not a requirement for correctness.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tfidf_tpu.engine.index import ShardIndex
+from tfidf_tpu.engine.searcher import Searcher, SearchHit
+from tfidf_tpu.engine.vocab import Vocabulary
+from tfidf_tpu.models.base import get_model
+from tfidf_tpu.ops.analyzer import Analyzer, extract_text
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.logging import Stopwatch, get_logger
+from tfidf_tpu.utils.tracing import trace_phase
+
+log = get_logger("engine")
+
+
+class Engine:
+    def __init__(self, config: Config | None = None) -> None:
+        self.config = config or Config()
+        c = self.config
+        self.analyzer = Analyzer(
+            lowercase=c.lowercase,
+            stopwords=frozenset(c.stopwords),
+            max_token_length=c.max_token_length)
+        self.model = get_model(c.model, k1=c.bm25_k1, b=c.bm25_b,
+                               lucene_parity=c.lucene_parity)
+        self.vocab = Vocabulary(min_capacity=c.min_vocab_capacity)
+        self.index = ShardIndex(
+            self.model,
+            min_nnz_cap=c.min_nnz_capacity,
+            min_doc_cap=c.min_doc_capacity)
+        self.searcher = Searcher(
+            self.index, self.analyzer, self.vocab, self.model,
+            query_batch=c.query_batch, max_query_terms=c.max_query_terms,
+            top_k=c.top_k, result_order=c.result_order)
+
+    # ---- ingest (Worker.upload / addDocToIndex analog) ----
+
+    def ingest_text(self, name: str, text: str) -> None:
+        with trace_phase("analyze"):
+            counts = self.analyzer.counts(text)
+            length = float(sum(counts.values()))
+            id_counts = self.vocab.map_counts(counts, add=True)
+        self.index.add_document(name, id_counts, length=length)
+
+    def ingest_bytes(self, name: str, data: bytes,
+                     save_to_disk: bool = False) -> None:
+        """Full upload path: optional durable write of the raw document
+        (the reference's ``Files.copy`` to ``${mydocument.path}``,
+        ``Worker.java:133-134``), then extract + index."""
+        if save_to_disk:
+            path = self._safe_doc_path(name)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".part"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        self.ingest_text(name, extract_text(data))
+
+    def delete(self, name: str) -> bool:
+        return self.index.delete_document(name)
+
+    def commit(self) -> None:
+        with trace_phase("commit"), Stopwatch() as sw:
+            self.index.commit(self.vocab.capacity())
+        log.info("commit", ms=sw.ms, docs=self.index.num_live_docs)
+
+    def build_from_directory(self, docs_path: str | None = None) -> int:
+        """Recovery-by-rebuild: walk the documents dir, upsert every regular
+        file keyed by its relative path, then commit (``Worker.java:77-88``).
+        Idempotent — safe to run on a non-empty index."""
+        root = docs_path or self.config.documents_path
+        n = 0
+        if os.path.isdir(root):
+            for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+                for fn in sorted(filenames):
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(full, root)
+                    try:
+                        with open(full, "rb") as f:
+                            self.ingest_text(rel, extract_text(f.read()))
+                        n += 1
+                    except OSError as e:  # unreadable file: skip, like walk
+                        log.warning("skipping unreadable file",
+                                    path=full, err=str(e))
+        self.commit()
+        log.info("rebuilt index from documents dir", root=root, docs=n)
+        return n
+
+    # ---- search (Worker.processDocuments analog) ----
+
+    def search(self, query: str, k: int | None = None,
+               unbounded: bool = False) -> list[SearchHit]:
+        return self.searcher.search([query], k=k, unbounded=unbounded)[0]
+
+    def search_batch(self, queries: list[str], k: int | None = None,
+                     unbounded: bool = False) -> list[list[SearchHit]]:
+        return self.searcher.search(queries, k=k, unbounded=unbounded)
+
+    # ---- files (Worker.workerDownload analog) ----
+
+    def _safe_doc_path(self, rel: str) -> str:
+        """Resolve under documents_path with the same traversal check as the
+        reference (``Worker.java:97-121``: normalize + startsWith(base))."""
+        base = os.path.abspath(self.config.documents_path)
+        target = os.path.abspath(os.path.join(base, rel))
+        if not (target == base or target.startswith(base + os.sep)):
+            raise PermissionError(f"path escapes documents dir: {rel!r}")
+        return target
+
+    def open_document(self, rel: str) -> bytes | None:
+        path = self._safe_doc_path(rel)
+        if not os.path.isfile(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    # ---- load metric ----
+
+    def index_size_bytes(self) -> int:
+        return self.index.size_bytes()

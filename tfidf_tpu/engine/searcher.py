@@ -1,0 +1,136 @@
+"""Query execution against a committed snapshot.
+
+Replaces the reference's per-query path (``Worker.java:222-241``): parse
+query with the same analyzer used at index time, score, return hits. Unlike
+the reference — one query at a time over HTTP — queries are batched into a
+fixed-size padded batch and scored in one device program; a single query is
+just a batch of one (padding is free: executables are cached per batch
+bucket).
+
+Only documents containing at least one query term are returned (score > 0),
+matching Lucene's behavior of only scoring docs in the postings of query
+terms. Unknown query terms are dropped (they can match nothing).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tfidf_tpu.engine.index import ShardIndex, Snapshot
+from tfidf_tpu.engine.vocab import Vocabulary
+from tfidf_tpu.models.base import ScoringModel
+from tfidf_tpu.ops.analyzer import Analyzer
+from tfidf_tpu.ops.csr import next_capacity
+from tfidf_tpu.ops.scoring import score_coo_batch
+from tfidf_tpu.ops.topk import exact_topk, full_ranking
+from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.tracing import trace_phase
+
+
+class SearchHit(NamedTuple):
+    name: str
+    score: float
+
+
+def vectorize_queries(queries: list[str], analyzer: Analyzer,
+                      vocab: Vocabulary, model: ScoringModel,
+                      *, batch_cap: int, max_terms: int
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """Analyze + pad a query batch to [batch_cap, max_terms].
+
+    Pad term id is 0 with weight 0 — inert by construction in the scoring
+    kernel. Queries with more than ``max_terms`` distinct terms keep the
+    highest-weight terms.
+    """
+    assert len(queries) <= batch_cap
+    q_terms = np.zeros((batch_cap, max_terms), np.int32)
+    q_weights = np.zeros((batch_cap, max_terms), np.float32)
+    for i, q in enumerate(queries):
+        counts = vocab.map_counts(analyzer.counts(q), add=False)
+        weights = model.query_weights(counts)
+        items = sorted(weights.items(), key=lambda kv: (-kv[1], kv[0]))
+        items = items[:max_terms]
+        for j, (tid, w) in enumerate(items):
+            q_terms[i, j] = tid
+            q_weights[i, j] = w
+    return q_terms, q_weights
+
+
+class Searcher:
+    def __init__(self, index: ShardIndex, analyzer: Analyzer,
+                 vocab: Vocabulary, model: ScoringModel,
+                 *, query_batch: int = 32, max_query_terms: int = 32,
+                 top_k: int = 10, result_order: str = "score") -> None:
+        self.index = index
+        self.analyzer = analyzer
+        self.vocab = vocab
+        self.model = model
+        self.query_batch = query_batch
+        self.max_query_terms = max_query_terms
+        self.top_k = top_k
+        # "name" reproduces the reference's alphabetical result ordering
+        # (Leader.java:80-91 sorts the merged map by document name)
+        self.result_order = result_order
+
+    def _batch_cap(self, n: int) -> int:
+        return min(self.query_batch, next_capacity(max(n, 1), 1))
+
+    def search(self, queries: list[str], k: int | None = None,
+               *, unbounded: bool = False) -> list[list[SearchHit]]:
+        """Score queries against the current snapshot.
+
+        ``unbounded=True`` returns every matching document (the reference's
+        ``Integer.MAX_VALUE`` behavior, ``Worker.java:230``) via a host-side
+        full ranking — parity mode only; exact top-k is the fast path.
+        """
+        snap = self.index.snapshot
+        if snap is None or not snap.doc_names:
+            return [[] for _ in queries]
+        k = self.top_k if k is None else k
+        out: list[list[SearchHit]] = []
+        cap = self._batch_cap(len(queries))
+        for lo in range(0, len(queries), cap):
+            chunk = queries[lo:lo + cap]
+            out.extend(self._search_batch(snap, chunk, k, unbounded))
+        global_metrics.inc("queries_served", len(queries))
+        return out
+
+    def _search_batch(self, snap: Snapshot, queries: list[str], k: int,
+                      unbounded: bool) -> list[list[SearchHit]]:
+        cap = self._batch_cap(len(queries))
+        with trace_phase("vectorize"):
+            q_terms, q_weights = vectorize_queries(
+                queries, self.analyzer, self.vocab, self.model,
+                batch_cap=cap, max_terms=self.max_query_terms)
+        with trace_phase("score"):
+            scores = score_coo_batch(
+                snap.tf, snap.term, snap.doc, snap.doc_len, snap.df,
+                jnp.asarray(q_terms), jnp.asarray(q_weights),
+                snap.n_docs, snap.avgdl, snap.doc_norms,
+                **self.model.score_kwargs())
+        n_live = len(snap.doc_names)
+        if unbounded:
+            with trace_phase("rank_all"):
+                vals, ids = full_ranking(scores, n_live)
+                vals = np.asarray(vals)
+                ids = np.asarray(ids)
+                kk = n_live
+        else:
+            with trace_phase("topk"):
+                kk = min(k, n_live)
+                vals, ids = exact_topk(scores, snap.num_docs, k=kk)
+                vals = np.asarray(vals)
+                ids = np.asarray(ids)
+        results: list[list[SearchHit]] = []
+        names = snap.doc_names
+        for i in range(len(queries)):
+            hits = [SearchHit(names[int(d)], float(v))
+                    for v, d in zip(vals[i, :kk], ids[i, :kk])
+                    if np.isfinite(v) and v > 0.0]
+            if self.result_order == "name":
+                hits.sort(key=lambda h: h.name)
+            results.append(hits)
+        return results

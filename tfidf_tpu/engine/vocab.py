@@ -1,0 +1,72 @@
+"""Vocabulary: term string <-> dense integer id.
+
+Lucene keeps terms as strings in its term dictionary (FST); a TPU index
+needs dense integer columns. The vocabulary is host-side, append-only, and
+monotone: ids are assigned in first-seen order, so a given ingest order is
+reproducible. Capacity for the device-side df array grows in power-of-two
+buckets (``vocab_capacity``) to bound recompilation (BASELINE config 5 — 5M
+n-gram terms — is why ids are dense and the df array is the only
+vocab-sized device structure).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tfidf_tpu.ops.csr import next_capacity
+
+
+class Vocabulary:
+    def __init__(self, min_capacity: int = 1 << 15) -> None:
+        self._ids: dict[str, int] = {}
+        self._terms: list[str] = []
+        self._min_capacity = min_capacity
+
+    def __len__(self) -> int:
+        return len(self._terms)
+
+    def capacity(self) -> int:
+        """Current power-of-two device capacity bucket (>= len + 1 so id 0's
+        pad-collision trick in scoring always has headroom)."""
+        return next_capacity(len(self._terms) + 1, self._min_capacity)
+
+    def add(self, term: str) -> int:
+        tid = self._ids.get(term)
+        if tid is None:
+            tid = len(self._terms)
+            self._ids[term] = tid
+            self._terms.append(term)
+        return tid
+
+    def lookup(self, term: str) -> int | None:
+        return self._ids.get(term)
+
+    def term(self, tid: int) -> str:
+        return self._terms[tid]
+
+    def map_counts(self, counts: dict[str, int], *,
+                   add: bool) -> dict[int, int]:
+        """Map a term->freq dict to id->freq. With ``add=False`` (query
+        side), unknown terms are dropped — they can match no document,
+        exactly like an out-of-dictionary term in Lucene."""
+        out: dict[int, int] = {}
+        for term, c in counts.items():
+            tid = self.add(term) if add else self._ids.get(term)
+            if tid is not None:
+                out[tid] = out.get(tid, 0) + c
+        return out
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for t in self._terms:
+                f.write(t + "\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str, min_capacity: int = 1 << 15) -> "Vocabulary":
+        v = cls(min_capacity)
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                v.add(line.rstrip("\n"))
+        return v

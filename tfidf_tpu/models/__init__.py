@@ -1,0 +1,13 @@
+from tfidf_tpu.models.base import ScoringModel, get_model
+from tfidf_tpu.models.bm25 import BM25Model, int_to_byte4, byte4_to_int
+from tfidf_tpu.models.tfidf import TfidfModel, TfidfCosineModel
+
+__all__ = [
+    "ScoringModel",
+    "get_model",
+    "BM25Model",
+    "TfidfModel",
+    "TfidfCosineModel",
+    "int_to_byte4",
+    "byte4_to_int",
+]

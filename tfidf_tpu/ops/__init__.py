@@ -1,0 +1,17 @@
+from tfidf_tpu.ops.analyzer import Analyzer, extract_text
+from tfidf_tpu.ops.csr import CooShard, build_coo, merge_coo
+from tfidf_tpu.ops.scoring import score_coo_batch, bm25_weights, tfidf_weights
+from tfidf_tpu.ops.topk import exact_topk, merge_topk
+
+__all__ = [
+    "Analyzer",
+    "extract_text",
+    "CooShard",
+    "build_coo",
+    "merge_coo",
+    "score_coo_batch",
+    "bm25_weights",
+    "tfidf_weights",
+    "exact_topk",
+    "merge_topk",
+]

@@ -1,0 +1,123 @@
+"""Text analysis: tokenizer + filters, and text extraction.
+
+TPU-native replacement for the reference's analysis chain, which is all
+library calls inside the worker:
+
+* Lucene ``StandardAnalyzer`` — used for both indexing and query parsing
+  (``Worker.java:71-73``, ``Worker.java:226-227``). Lucene 9's
+  ``StandardAnalyzer`` is ``StandardTokenizer`` (Unicode UAX#29 word
+  boundaries) + ``LowerCaseFilter``, with an EMPTY default stopword set and
+  a 255-char max token length. We reproduce that chain closely enough for
+  top-k parity: alphanumeric runs with UAX#29's MidLetter apostrophe rule
+  ("can't" is one token) and MidNum rule ("3.14" is one token).
+* Apache Tika ``AutoDetectParser`` — the reference's fallback for non-UTF-8
+  bytes (``Worker.java:198-212``). Binary-format (PDF/DOCX) extraction is
+  "future work" in the reference too (``README.MD:151``); we match its real
+  coverage with a charset-fallback decoder.
+
+The pure-Python tokenizer is the portable baseline implementation (a C++
+fast path for the ingest hot loop is planned under ``native/``).
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from dataclasses import dataclass
+from typing import Iterable
+
+# UAX#29-approximation:
+#   - a token is a run of word characters (letters/digits/underscore —
+#     underscore is ExtendNumLet in UAX#29, so "foo_bar" is one token);
+#   - ' or ’ between letters does not break ("can't");
+#   - . or , between digits does not break ("3.14", "1,000").
+_TOKEN_RE = re.compile(r"\d+(?:[.,]\d+)*|\w+(?:['’]\w+)*", re.UNICODE)
+
+
+@dataclass(frozen=True)
+class Analyzer:
+    """StandardAnalyzer-compatible chain: tokenize -> lowercase -> stop -> cap.
+
+    Defaults mirror Lucene 9 ``StandardAnalyzer()``: lowercase on, no
+    stopwords, ``maxTokenLength=255`` (overlong runs are *split*, like
+    StandardTokenizer, not dropped).
+    """
+
+    lowercase: bool = True
+    stopwords: frozenset[str] = frozenset()
+    max_token_length: int = 255
+
+    def tokens(self, text: str) -> list[str]:
+        out: list[str] = []
+        lower = self.lowercase
+        cap = self.max_token_length
+        stop = self.stopwords
+        for m in _TOKEN_RE.finditer(text):
+            tok = m.group()
+            if lower:
+                tok = tok.lower()
+            if len(tok) > cap:
+                # StandardTokenizer splits tokens longer than maxTokenLength
+                for i in range(0, len(tok), cap):
+                    piece = tok[i:i + cap]
+                    if piece and piece not in stop:
+                        out.append(piece)
+                continue
+            if tok in stop:
+                continue
+            out.append(tok)
+        return out
+
+    def counts(self, text: str) -> dict[str, int]:
+        """Term -> frequency for one document (the per-doc TF map)."""
+        freqs: dict[str, int] = {}
+        for tok in self.tokens(text):
+            freqs[tok] = freqs.get(tok, 0) + 1
+        return freqs
+
+
+def make_analyzer(lowercase: bool = True,
+                  stopwords: Iterable[str] = (),
+                  max_token_length: int = 255) -> Analyzer:
+    return Analyzer(lowercase=lowercase,
+                    stopwords=frozenset(stopwords),
+                    max_token_length=max_token_length)
+
+
+# --- text extraction (the Tika role) -------------------------------------
+
+# Charsets tried in order after strict UTF-8 fails — mirrors the reference's
+# Files.readString -> MalformedInputException -> Tika fallback
+# (Worker.java:198-212), which for plain text amounts to charset detection.
+_FALLBACK_ENCODINGS = ("utf-8", "utf-16", "latin-1")
+
+
+def extract_text(data: bytes) -> str:
+    """Decode document bytes to text with charset fallback.
+
+    UTF-8 first (strict, like ``Files.readString``), then UTF-16 if a BOM is
+    present, then Latin-1 (which never fails) with control characters
+    stripped so binary garbage degrades to near-empty text instead of
+    poisoning the vocabulary.
+    """
+    try:
+        return data.decode("utf-8")
+    except UnicodeDecodeError:
+        pass
+    if data[:2] in (b"\xff\xfe", b"\xfe\xff"):
+        try:
+            return data.decode("utf-16")
+        except UnicodeDecodeError:
+            pass
+    text = data.decode("latin-1")
+    # Strip C0/C1 control chars (keep \t\n\r) — binary files decode to noise.
+    return "".join(
+        ch if ch in "\t\n\r" or not unicodedata.category(ch).startswith("C")
+        else " "
+        for ch in text
+    )
+
+
+def extract_file(path: str) -> str:
+    with open(path, "rb") as f:
+        return extract_text(f.read())

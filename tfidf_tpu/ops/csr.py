@@ -1,0 +1,162 @@
+"""Padded COO/CSR term-document shard — the TPU-native index structure.
+
+This replaces the per-worker Lucene inverted index (reference
+``worker/Worker.java:54-94``: ``FSDirectory`` + ``IndexWriter``). Instead of
+postings lists on disk, a shard is a set of fixed-capacity device arrays in
+coordinate format, row-sorted (so it is simultaneously an expanded CSR):
+
+    tf[nnz_cap]       f32  raw term frequency of (doc, term)
+    term[nnz_cap]     i32  term id (column / vocabulary axis)
+    doc[nnz_cap]      i32  local document id (row axis), non-decreasing
+    doc_len[doc_cap]  f32  analyzed token count per document (BM25 norm)
+    df[vocab_cap]     f32  per-shard document frequency per term
+    nnz, num_docs     i32  scalars: live extents inside the padding
+
+Why padded capacities: XLA traces once per shape, so every capacity is drawn
+from power-of-two buckets — appending documents reuses the compiled scoring
+executable until a bucket overflows (the analog of Lucene's segment growth,
+``Worker.java:88,138``). Padding is inert by construction: padded ``tf`` is 0
+so scoring contributions vanish, padded ``doc`` points at row 0 harmlessly.
+
+Host-side building is numpy; arrays move to device once per commit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Sequence
+
+import numpy as np
+
+
+def next_capacity(n: int, minimum: int) -> int:
+    """Power-of-two capacity bucket, so shapes (and XLA executables) are reused."""
+    cap = max(int(minimum), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+@dataclass
+class CooShard:
+    """Host (numpy) or device (jax.Array) resident shard; same field layout.
+
+    The fields form a pytree of arrays plus static ints, so a device-resident
+    instance can be passed straight into jitted scoring functions.
+    """
+
+    tf: np.ndarray        # f32 [nnz_cap]
+    term: np.ndarray      # i32 [nnz_cap]
+    doc: np.ndarray       # i32 [nnz_cap]
+    doc_len: np.ndarray   # f32 [doc_cap]
+    df: np.ndarray        # f32 [vocab_cap]
+    nnz: int
+    num_docs: int
+
+    @property
+    def nnz_cap(self) -> int:
+        return self.tf.shape[0]
+
+    @property
+    def doc_cap(self) -> int:
+        return self.doc_len.shape[0]
+
+    @property
+    def vocab_cap(self) -> int:
+        return self.df.shape[0]
+
+    @property
+    def total_terms(self) -> float:
+        """Sum of doc lengths — numerator of avgdl (Lucene sumTotalTermFreq)."""
+        return float(np.asarray(self.doc_len).sum())
+
+    def size_bytes(self) -> int:
+        """The load metric — analog of GET /worker/index-size
+        (reference ``Worker.java:147-172``), used for least-loaded placement."""
+        return int(self.tf.nbytes + self.term.nbytes + self.doc.nbytes
+                   + self.doc_len.nbytes + self.df.nbytes)
+
+
+def build_coo(doc_counts: Sequence[dict[int, int]],
+              vocab_cap: int,
+              min_nnz_cap: int = 1 << 16,
+              min_doc_cap: int = 1024) -> CooShard:
+    """Build a padded shard from per-document {term_id: freq} maps.
+
+    ``doc_counts[i]`` is the analyzed TF map of local document ``i`` (what the
+    reference builds implicitly inside Lucene at ``Worker.java:214-219``).
+    """
+    n_docs = len(doc_counts)
+    nnz = sum(len(c) for c in doc_counts)
+    nnz_cap = next_capacity(nnz, min_nnz_cap)
+    doc_cap = next_capacity(max(n_docs, 1), min_doc_cap)
+
+    tf = np.zeros(nnz_cap, np.float32)
+    term = np.zeros(nnz_cap, np.int32)
+    doc = np.zeros(nnz_cap, np.int32)
+    doc_len = np.zeros(doc_cap, np.float32)
+    df = np.zeros(vocab_cap, np.float32)
+
+    pos = 0
+    for i, counts in enumerate(doc_counts):
+        if counts:
+            # sort terms for determinism + locality of the term axis
+            items = sorted(counts.items())
+            k = len(items)
+            term[pos:pos + k] = [t for t, _ in items]
+            tf[pos:pos + k] = [f for _, f in items]
+            doc[pos:pos + k] = i
+            pos += k
+            df[[t for t, _ in items]] += 1.0
+        doc_len[i] = float(sum(counts.values()))
+    assert pos == nnz
+    return CooShard(tf=tf, term=term, doc=doc, doc_len=doc_len, df=df,
+                    nnz=nnz, num_docs=n_docs)
+
+
+def merge_coo(shards: Sequence[CooShard],
+              vocab_cap: int,
+              min_nnz_cap: int = 1 << 16,
+              min_doc_cap: int = 1024) -> CooShard:
+    """Compact several shards into one (host-side segment merge).
+
+    The analog of Lucene's segment merging: the engine accumulates small
+    per-commit segments and periodically compacts them so the device holds
+    one contiguous shard. Local doc ids are renumbered by concatenation
+    order.
+    """
+    total_nnz = sum(s.nnz for s in shards)
+    total_docs = sum(s.num_docs for s in shards)
+    nnz_cap = next_capacity(total_nnz, min_nnz_cap)
+    doc_cap = next_capacity(max(total_docs, 1), min_doc_cap)
+
+    tf = np.zeros(nnz_cap, np.float32)
+    term = np.zeros(nnz_cap, np.int32)
+    doc = np.zeros(nnz_cap, np.int32)
+    doc_len = np.zeros(doc_cap, np.float32)
+    df = np.zeros(vocab_cap, np.float32)
+
+    pos = 0
+    doc_base = 0
+    for s in shards:
+        k = s.nnz
+        tf[pos:pos + k] = np.asarray(s.tf)[:k]
+        term[pos:pos + k] = np.asarray(s.term)[:k]
+        doc[pos:pos + k] = np.asarray(s.doc)[:k] + doc_base
+        pos += k
+        doc_len[doc_base:doc_base + s.num_docs] = (
+            np.asarray(s.doc_len)[:s.num_docs])
+        sdf = np.asarray(s.df)
+        df[:sdf.shape[0]] += sdf
+        doc_base += s.num_docs
+    return CooShard(tf=tf, term=term, doc=doc, doc_len=doc_len, df=df,
+                    nnz=total_nnz, num_docs=total_docs)
+
+
+def widen_vocab(shard: CooShard, vocab_cap: int) -> CooShard:
+    """Grow the df array when the vocabulary outgrows its capacity bucket."""
+    if vocab_cap <= shard.vocab_cap:
+        return shard
+    df = np.zeros(vocab_cap, np.float32)
+    df[:shard.vocab_cap] = np.asarray(shard.df)
+    return replace(shard, df=df)

@@ -1,0 +1,16 @@
+from tfidf_tpu.parallel.mesh import make_mesh, default_mesh_shape
+from tfidf_tpu.parallel.sharded import (
+    ShardedArrays,
+    build_sharded_arrays,
+    make_sharded_search,
+    global_stats,
+)
+
+__all__ = [
+    "make_mesh",
+    "default_mesh_shape",
+    "ShardedArrays",
+    "build_sharded_arrays",
+    "make_sharded_search",
+    "global_stats",
+]

@@ -1,0 +1,60 @@
+"""Device mesh construction.
+
+The reference's "mesh" is a set of pods discovered through ZooKeeper
+(``registry/ServiceRegistry.java``) and addressed one HTTP call at a time.
+Here the equivalent is a ``jax.sharding.Mesh`` over TPU devices with two
+axes:
+
+* ``docs``  — data parallelism over the corpus: each slice owns a disjoint
+  set of documents, exactly like the reference's workers (its only
+  parallelism axis, SURVEY.md §2). Collectives over this axis: ``psum`` of
+  document frequencies (global IDF — an improvement the reference never
+  had), ``all_gather`` of per-shard top-k.
+* ``terms`` — intra-document parallelism over postings: one document's
+  entries are split across devices and partial scores ``psum``-reduced.
+  This is the sequence-parallel analog for this workload — it is what lets
+  arbitrarily long documents / dense shards scale beyond one device's HBM,
+  where the reference simply holds whole documents on one worker
+  (SURVEY.md §5.7).
+
+Multi-host: under ``jax.distributed.initialize`` the same mesh spans hosts;
+``docs`` is laid out over DCN (independent shards, no intra-query traffic
+except the final k-sized gather) and ``terms`` over ICI (per-query psum).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def default_mesh_shape(n_devices: int | None = None) -> tuple[int, int]:
+    """(docs, terms) shape: favor the docs axis, keep terms a small power of 2.
+
+    Scoring traffic per query over ``terms`` is a [B, doc_cap] psum, while
+    ``docs`` shards are embarrassingly parallel — so docs-major is the right
+    default, mirroring the scaling-book recipe of putting the cheap axis on
+    the slower links.
+    """
+    n = n_devices if n_devices is not None else len(jax.devices())
+    terms = 1
+    while n % 2 == 0 and n // 2 >= 4 and terms < 2:
+        # only fold into terms when there are plenty of devices
+        n //= 2
+        terms *= 2
+    return (n, terms)
+
+
+def make_mesh(shape: tuple[int, int] | None = None,
+              axis_names: tuple[str, str] = ("docs", "terms"),
+              devices: list | None = None) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
+    if shape is None or not shape:
+        shape = (len(devs), 1)
+    if math.prod(shape) != len(devs):
+        raise ValueError(f"mesh shape {shape} != {len(devs)} devices")
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, axis_names)

@@ -1,0 +1,247 @@
+"""Sharded scoring over the device mesh — the distributed forward step.
+
+This subsumes the reference's entire scatter-gather data path
+(``leader/Leader.java:39-92``: serial HTTP fan-out to every worker, JSON
+score lists back, ``Map.merge`` sum at the leader) with one ``shard_map``
+program over a ``("docs", "terms")`` mesh:
+
+    scatter  -> the query batch is replicated to every device by sharding
+    per-shard scoring -> local COO postings scored on-device
+    global IDF        -> ``psum`` of per-shard document frequencies over the
+                         whole mesh (the reference never globalizes IDF —
+                         each Lucene worker scores against local stats; we
+                         expose that behavior as parity mode and global IDF
+                         as the default, SURVEY.md §7 Phase B)
+    score reduce      -> ``psum`` of partial scores over the ``terms`` axis
+    gather   -> per-docs-shard exact top-k, ``all_gather`` over ``docs``,
+                associative re-top-k; every device ends with the answer
+
+Collectives ride ICI inside one jitted program — there is no host round-trip
+per worker, which is where the >=50x headroom over the Java system lives.
+
+Host-side layout (``build_sharded_arrays``): documents are dealt
+round-robin into ``D`` docs-shards (upload balancing is handled upstream by
+the engine); each shard's row-sorted COO is split into ``T`` contiguous
+chunks along nnz. Any disjoint partition of entries is correct because both
+df and scores are additive over entries; contiguous chunking keeps the
+partition balanced to within one entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfidf_tpu.ops.csr import CooShard, next_capacity
+from tfidf_tpu.ops.scoring import cosine_norms, score_coo_impl
+from tfidf_tpu.ops.topk import exact_topk, merge_topk
+
+
+@dataclass
+class ShardedArrays:
+    """Global (addressable-on-mesh) arrays for the whole corpus.
+
+    Leading axes: D = docs shards, T = terms shards.
+    """
+
+    tf: jax.Array        # f32 [D, T, chunk_cap]
+    term: jax.Array      # i32 [D, T, chunk_cap]
+    doc: jax.Array       # i32 [D, T, chunk_cap]
+    doc_len: jax.Array   # f32 [D, doc_cap]
+    df: jax.Array        # f32 [D, T, vocab_cap] (per-shard partial df)
+    n_live: jax.Array    # i32 [D] live docs per docs-shard
+    doc_cap: int
+    vocab_cap: int
+
+    @property
+    def shape_dt(self) -> tuple[int, int]:
+        return self.tf.shape[0], self.tf.shape[1]
+
+
+jax.tree_util.register_dataclass(
+    ShardedArrays,
+    data_fields=["tf", "term", "doc", "doc_len", "df", "n_live"],
+    meta_fields=["doc_cap", "vocab_cap"],
+)
+
+
+def shard_documents(n_docs: int, n_shards: int) -> np.ndarray:
+    """Round-robin placement: doc i -> shard i % D (balanced, deterministic).
+
+    The engine's least-loaded placement (reference ``Leader.java:168-189``)
+    applies at ingest; this is the static layout for mesh-resident scoring.
+    """
+    return np.arange(n_docs, dtype=np.int64) % n_shards
+
+
+def build_sharded_arrays(shard: CooShard,
+                         mesh: Mesh,
+                         min_chunk_cap: int = 1 << 14) -> ShardedArrays:
+    """Partition one host COO shard across a (docs, terms) mesh.
+
+    Returns device arrays placed with NamedShardings so each mesh slice
+    holds exactly its block.
+    """
+    D = mesh.shape["docs"]
+    T = mesh.shape["terms"]
+    nnz, n_docs = shard.nnz, shard.num_docs
+    tf = np.asarray(shard.tf)[:nnz]
+    term = np.asarray(shard.term)[:nnz]
+    doc = np.asarray(shard.doc)[:nnz].astype(np.int64)
+    doc_len_src = np.asarray(shard.doc_len)
+    vocab_cap = shard.vocab_cap
+
+    assign = shard_documents(n_docs, D)          # global doc -> docs shard
+    local_id = np.zeros(n_docs, np.int64)
+    counts = np.zeros(D, np.int64)
+    for s in range(D):
+        mask = assign == s
+        local_id[mask] = np.arange(mask.sum())
+        counts[s] = mask.sum()
+    doc_cap = next_capacity(max(int(counts.max()) if D else 1, 1), 1024)
+
+    entry_shard = assign[doc]                    # nnz -> docs shard
+    chunk_caps = []
+    per_shard = []
+    for s in range(D):
+        m = entry_shard == s
+        k = int(m.sum())
+        per_shard.append((tf[m], term[m], local_id[doc[m]].astype(np.int32)))
+        chunk_caps.append(-(-k // T))            # ceil split over terms
+    chunk_cap = next_capacity(max(max(chunk_caps, default=1), 1),
+                              min_chunk_cap)
+
+    g_tf = np.zeros((D, T, chunk_cap), np.float32)
+    g_term = np.zeros((D, T, chunk_cap), np.int32)
+    g_doc = np.zeros((D, T, chunk_cap), np.int32)
+    g_len = np.zeros((D, doc_cap), np.float32)
+    g_df = np.zeros((D, T, vocab_cap), np.float32)
+    for s in range(D):
+        stf, sterm, sdoc = per_shard[s]
+        k = stf.shape[0]
+        for t in range(T):
+            lo = t * -(-k // T) if k else 0
+            hi = min(k, (t + 1) * -(-k // T)) if k else 0
+            n = max(hi - lo, 0)
+            if n > 0:
+                g_tf[s, t, :n] = stf[lo:hi]
+                g_term[s, t, :n] = sterm[lo:hi]
+                g_doc[s, t, :n] = sdoc[lo:hi]
+                # df is additive over any disjoint entry partition, but must
+                # count each (doc, term) pair once — COO entries are unique
+                # pairs, so counting entries is exactly df.
+                np.add.at(g_df[s, t], sterm[lo:hi], 1.0)
+        live = assign == s
+        g_len[s, :int(counts[s])] = doc_len_src[:n_docs][live]
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return ShardedArrays(
+        tf=put(g_tf, P("docs", "terms", None)),
+        term=put(g_term, P("docs", "terms", None)),
+        doc=put(g_doc, P("docs", "terms", None)),
+        doc_len=put(g_len, P("docs", None)),
+        df=put(g_df, P("docs", "terms", None)),
+        n_live=put(counts.astype(np.int32), P("docs")),
+        doc_cap=doc_cap,
+        vocab_cap=vocab_cap,
+    )
+
+
+def global_stats(arrays: ShardedArrays) -> tuple[jax.Array, jax.Array]:
+    """(N, avgdl) over the whole mesh — host-visible scalars."""
+    n = jnp.sum(arrays.n_live).astype(jnp.float32)
+    total = jnp.sum(arrays.doc_len)
+    return n, total / jnp.maximum(n, 1.0)
+
+
+def make_sharded_search(mesh: Mesh,
+                        *,
+                        k: int,
+                        model: str = "bm25",
+                        k1: float = 1.2,
+                        b: float = 0.75,
+                        global_idf: bool = True,
+                        chunk: int = 1 << 17):
+    """Build the jitted distributed search step for a fixed mesh/model.
+
+    Returned callable:
+        step(arrays: ShardedArrays, q_terms [B,T_q], q_weights [B,T_q])
+            -> (top_vals [B,k], top_global_ids [B,k])
+
+    ``top_global_ids`` encode (docs_shard, local_id) as shard * doc_cap + id;
+    the engine maps them back to document names.
+
+    ``global_idf=False`` reproduces the reference's per-worker statistics
+    (each Lucene shard scores against local df/N — ``Worker.java:222-241``)
+    for parity testing.
+    """
+
+    def step(tf, term, doc, doc_len, df, n_live, q_terms, q_weights):
+        tf = tf.reshape(tf.shape[-1])
+        term = term.reshape(term.shape[-1])
+        doc = doc.reshape(doc.shape[-1])
+        doc_len = doc_len.reshape(doc_len.shape[-1])
+        df_local = df.reshape(df.shape[-1])
+        n_local = n_live.reshape(())
+
+        doc_cap = doc_len.shape[0]
+
+        if global_idf:
+            # THE collective the north star names: global document frequency
+            # via psum over the whole mesh (entries are disjoint across both
+            # axes, so summing both is exact).
+            df_eff = jax.lax.psum(df_local, ("docs", "terms"))
+            n_eff = jax.lax.psum(n_local.astype(jnp.float32), "docs")
+            total_len = jax.lax.psum(jnp.sum(doc_len), "docs")
+            avgdl = total_len / jnp.maximum(n_eff, 1.0)
+        else:
+            # Parity mode: per-docs-shard stats, as each Java worker sees.
+            df_eff = jax.lax.psum(df_local, "terms")
+            n_eff = n_local.astype(jnp.float32)
+            avgdl = jnp.sum(doc_len) / jnp.maximum(n_eff, 1.0)
+
+        doc_norms = None
+        if model == "tfidf_cosine":
+            # Norms depend on (global) df, so they are computed in-step:
+            # per-entry squared weights segment-summed locally, then reduced
+            # over the terms axis (a document's entries span terms shards).
+            sq = cosine_norms(tf, term, doc, df_eff, n_eff, doc_cap) ** 2
+            doc_norms = jnp.sqrt(jax.lax.psum(sq, "terms"))
+
+        partial = score_coo_impl(
+            tf, term, doc, doc_len, df_eff, q_terms, q_weights,
+            n_eff, avgdl, doc_norms, model=model, k1=k1, b=b, chunk=chunk)
+
+        scores = jax.lax.psum(partial, "terms")        # [B, doc_cap]
+        vals, ids = exact_topk(scores, n_local, k=k)
+        shard_idx = jax.lax.axis_index("docs").astype(jnp.int32)
+        gids = shard_idx * jnp.int32(doc_cap) + ids
+
+        all_vals = jax.lax.all_gather(vals, "docs")    # [D, B, k]
+        all_ids = jax.lax.all_gather(gids, "docs")
+        top_vals, top_ids = merge_topk(all_vals, all_ids)
+        return top_vals, top_ids
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("docs", "terms", None), P("docs", "terms", None),
+                  P("docs", "terms", None), P("docs", None),
+                  P("docs", "terms", None), P("docs"),
+                  P(None, None), P(None, None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def search(arrays: ShardedArrays, q_terms, q_weights):
+        return sharded(arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
+                       arrays.df, arrays.n_live, q_terms, q_weights)
+
+    return search

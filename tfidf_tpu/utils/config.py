@@ -1,0 +1,113 @@
+"""Single-dataclass configuration with environment-variable overrides.
+
+The reference configures itself through Spring ``application.properties``
+(``src/main/resources/application.properties:1-8`` — ``zookeeper.connection``,
+``mydocument.path``, ``lucene.index.path``, ``server.port``) plus raw env vars
+``POD_IP`` / ``SERVER_PORT`` read in ``OnElectionAction.java:35-36,64-68``.
+Here the whole surface is one frozen dataclass; every field can be overridden
+by a ``TFIDF_<UPPER_NAME>`` environment variable, so a Kubernetes Deployment
+can configure nodes exactly the way the reference's manifest does
+(``README.MD:80-90``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+from typing import Any
+
+_ENV_PREFIX = "TFIDF_"
+
+
+@dataclass(frozen=True)
+class Config:
+    # --- paths (reference: application.properties:5-7) ---
+    documents_path: str = "./data/documents"
+    index_path: str = "./data/index"
+
+    # --- node / control plane (reference: application.properties:2,8) ---
+    coordinator_address: str = "127.0.0.1:2181"
+    host: str = "127.0.0.1"
+    port: int = 8085
+    # Liveness: the reference's ZooKeeper session timeout doubles as the
+    # failure detector (ZookeeperConfig.java:17, sessionTimeout=3000ms).
+    session_timeout_s: float = 3.0
+    heartbeat_interval_s: float = 0.5
+
+    # --- scoring model ---
+    model: str = "bm25"          # "bm25" | "tfidf" | "tfidf_cosine"
+    bm25_k1: float = 1.2         # Lucene BM25Similarity defaults
+    bm25_b: float = 0.75
+    # Parity mode reproduces Lucene quirks bit-for-bit: SmallFloat 1-byte
+    # norm quantization and per-shard (non-global) IDF (Worker.java:222-241).
+    lucene_parity: bool = False
+    # Result ordering: the reference sorts by document NAME, not score
+    # (Leader.java:80-91, comparingByKey). "score" is the sane default.
+    result_order: str = "score"  # "score" | "name"
+    top_k: int = 10
+
+    # --- analyzer ---
+    lowercase: bool = True
+    stopwords: tuple[str, ...] = ()   # Lucene 9 StandardAnalyzer default: none
+    max_token_length: int = 255       # StandardAnalyzer.maxTokenLength default
+
+    # --- mesh / parallelism ---
+    mesh_shape: tuple[int, ...] = ()   # () = all local devices on one "docs" axis
+    mesh_axes: tuple[str, ...] = ("docs", "terms")
+    query_batch: int = 32              # padded query batch per scoring step
+    max_query_terms: int = 32          # padded terms per query
+
+    # --- capacity bucketing (static shapes for XLA) ---
+    min_doc_capacity: int = 1024
+    min_nnz_capacity: int = 1 << 16
+    min_vocab_capacity: int = 1 << 15
+
+    # --- misc ---
+    log_level: str = "INFO"
+    seed: int = 0
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _coerce(raw: str, ty: type) -> Any:
+    if ty is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    if ty is str:
+        return raw
+    # tuples and anything else: JSON
+    val = json.loads(raw)
+    return tuple(val) if isinstance(val, list) else val
+
+
+def load_config(path: str | None = None, env: dict[str, str] | None = None,
+                **overrides: Any) -> Config:
+    """Build a Config from (lowest to highest precedence): defaults, a JSON
+    config file, ``TFIDF_*`` environment variables, keyword overrides."""
+    env = os.environ if env is None else env
+    values: dict[str, Any] = {}
+    if path and os.path.exists(path):
+        with open(path) as f:
+            loaded = json.load(f)
+        for f_ in dataclasses.fields(Config):
+            if f_.name in loaded:
+                v = loaded[f_.name]
+                values[f_.name] = tuple(v) if isinstance(v, list) else v
+    for f_ in dataclasses.fields(Config):
+        key = _ENV_PREFIX + f_.name.upper()
+        if key in env:
+            base = Config.__dataclass_fields__[f_.name].default
+            ty = type(base) if base is not None and not isinstance(
+                base, dataclasses._MISSING_TYPE) else str
+            values[f_.name] = _coerce(env[key], ty)
+    values.update(overrides)
+    return Config(**values)

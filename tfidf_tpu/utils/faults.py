@@ -1,0 +1,80 @@
+"""Fault injection.
+
+The reference tolerates faults (ZooKeeper ephemeral-node liveness, partial
+scatter-gather, ``Leader.java:67-69``) but has no way to *inject* them
+(SURVEY.md §5.3: "Fault injection: none"). This module adds that capability:
+named fault points are sprinkled through the control plane (worker RPC,
+heartbeat, checkpoint write) and a test/chaos harness can arm them to raise,
+delay, or drop with a given probability.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+class FaultInjected(RuntimeError):
+    pass
+
+
+@dataclass
+class _Rule:
+    action: str            # "raise" | "delay" | "callable"
+    probability: float = 1.0
+    delay_s: float = 0.0
+    remaining: int | None = None   # fire at most N times; None = unlimited
+    fn: object = None
+
+
+class FaultInjector:
+    def __init__(self, seed: int | None = None) -> None:
+        self._rules: dict[str, _Rule] = {}
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self.fired: dict[str, int] = {}
+
+    def arm(self, point: str, action: str = "raise", probability: float = 1.0,
+            delay_s: float = 0.0, times: int | None = None,
+            fn=None) -> None:
+        with self._lock:
+            self._rules[point] = _Rule(action, probability, delay_s, times, fn)
+
+    def disarm(self, point: str | None = None) -> None:
+        with self._lock:
+            if point is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(point, None)
+
+    def check(self, point: str) -> None:
+        with self._lock:
+            rule = self._rules.get(point)
+            if rule is None:
+                return
+            if rule.remaining is not None:
+                if rule.remaining <= 0:
+                    return
+            if self._rng.random() > rule.probability:
+                return
+            if rule.remaining is not None:
+                rule.remaining -= 1
+            self.fired[point] = self.fired.get(point, 0) + 1
+            action, delay_s, fn = rule.action, rule.delay_s, rule.fn
+        if action == "delay":
+            time.sleep(delay_s)
+        elif action == "callable" and fn is not None:
+            fn()
+        elif action == "raise":
+            raise FaultInjected(f"fault injected at {point!r}")
+
+
+# Process-wide injector used by library fault points; tests arm/disarm it.
+global_injector = FaultInjector()
+
+
+def fault_point(name: str) -> None:
+    """Call at a named site; no-op unless a test armed this point."""
+    global_injector.check(name)

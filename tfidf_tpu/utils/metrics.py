@@ -1,0 +1,61 @@
+"""Counters and gauges.
+
+The reference exposes exactly one numeric metric — index size in bytes,
+``GET /worker/index-size`` (``Worker.java:147-172``) — consumed by the upload
+balancer (``Leader.java:170-185``). We keep that metric (as shard ``nnz`` and
+byte size) and add the counters the reference never had (§5.5 of SURVEY.md):
+docs indexed, queries served, collective timings, per-phase latencies.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        # histogram-lite: (count, sum, min, max) per key
+        self._timings: dict[str, list[float]] = defaultdict(
+            lambda: [0, 0.0, float("inf"), 0.0])
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            t = self._timings[name]
+            t[0] += 1
+            t[1] += seconds
+            t[2] = min(t[2], seconds)
+            t[3] = max(t[3], seconds)
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            out: dict[str, Any] = dict(self._counters)
+            out.update(self._gauges)
+            for name, (n, total, mn, mx) in self._timings.items():
+                if n:
+                    out[f"{name}_count"] = n
+                    out[f"{name}_mean_ms"] = round(total / n * 1e3, 3)
+                    out[f"{name}_min_ms"] = round(mn * 1e3, 3)
+                    out[f"{name}_max_ms"] = round(mx * 1e3, 3)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timings.clear()
+
+
+global_metrics = Metrics()

@@ -1,0 +1,53 @@
+"""Tracing / profiling hooks.
+
+The reference has none (SURVEY.md §5.1) — its only visibility is log lines
+around each request. Here every pipeline phase (analyze / vectorize / score /
+top-k / collective) runs inside ``trace_phase``, which (a) records wall time
+into the global metrics, and (b) opens a ``jax.profiler.TraceAnnotation`` so
+phases show up named in TensorBoard/Perfetto traces captured with
+``jax.profiler.start_trace``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+from tfidf_tpu.utils.metrics import global_metrics
+
+try:  # jax is always present in this image, but keep host-only tools usable
+    import jax.profiler as _jprof
+except Exception:  # pragma: no cover
+    _jprof = None
+
+
+@contextlib.contextmanager
+def trace_phase(name: str) -> Iterator[None]:
+    t0 = time.perf_counter()
+    ann = (_jprof.TraceAnnotation(name) if _jprof is not None
+           else contextlib.nullcontext())
+    with ann:
+        try:
+            yield
+        finally:
+            global_metrics.observe(f"phase_{name}", time.perf_counter() - t0)
+
+
+def phase_timings() -> dict[str, float]:
+    """Snapshot of per-phase timing stats (phase_* keys only)."""
+    return {k: v for k, v in global_metrics.snapshot().items()
+            if k.startswith("phase_")}
+
+
+@contextlib.contextmanager
+def profile_to(logdir: str) -> Iterator[None]:
+    """Capture a full XLA/TPU profiler trace into ``logdir``."""
+    if _jprof is None:  # pragma: no cover
+        yield
+        return
+    _jprof.start_trace(logdir)
+    try:
+        yield
+    finally:
+        _jprof.stop_trace()

@@ -1,0 +1,176 @@
+"""Benchmark: batched query scoring on TPU vs a vectorized CPU baseline.
+
+Config 1 of BASELINE.md (20-Newsgroups scale: ~18k docs, ~60k vocab),
+synthesized with a Zipfian term distribution since the environment has no
+network egress. The pipeline measured is the real one: text -> analyzer ->
+vocab -> COO commit -> device scoring with exact top-10.
+
+The baseline (denominator of ``vs_baseline``) is the same scoring math run
+as fully vectorized numpy on the host CPU — a *stronger* stand-in for the
+reference's per-worker scoring loop than the Java system itself (which
+scores one query at a time over HTTP, ``Leader.java:51-70``); beating it is
+beating an optimistic reference.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Human-readable detail goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_DOCS = 18_000
+VOCAB = 60_000
+AVG_LEN = 150
+BATCH = 32
+N_BATCHES = 32          # timed batches (per side)
+CPU_BATCHES = 4         # numpy baseline is slow; extrapolate from fewer
+TOP_K = 10
+SEED = 0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_corpus(rng) -> list[str]:
+    """Zipfian synthetic corpus as raw text (exercises the full ingest)."""
+    zipf = rng.zipf(1.25, size=N_DOCS * AVG_LEN) % VOCAB
+    lengths = np.clip(rng.poisson(AVG_LEN, N_DOCS), 10, None)
+    lengths = (lengths * (zipf.shape[0] / lengths.sum())).astype(np.int64)
+    texts = []
+    pos = 0
+    for n in lengths:
+        ids = zipf[pos:pos + n]
+        pos += n
+        texts.append(" ".join(f"t{w}" for w in ids))
+    return texts
+
+
+def make_queries(rng, vocab_size: int, n: int) -> list[str]:
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(2, 5))
+        # query terms skewed like the corpus so they actually hit postings
+        ids = rng.zipf(1.25, size=k) % vocab_size
+        out.append(" ".join(f"t{w}" for w in ids))
+    return out
+
+
+def bench_tpu(texts: list[str], queries: list[str]) -> tuple[float, float]:
+    from tfidf_tpu.engine import Engine
+    from tfidf_tpu.utils.config import Config
+
+    engine = Engine(Config(query_batch=BATCH))
+    t0 = time.perf_counter()
+    for i, text in enumerate(texts):
+        engine.ingest_text(f"doc{i}", text)
+    engine.commit()
+    index_s = time.perf_counter() - t0
+    log(f"[tpu] indexed {len(texts)} docs in {index_s:.2f}s "
+        f"({len(texts)/index_s:.0f} docs/s), nnz={engine.index.snapshot.nnz}, "
+        f"vocab={len(engine.vocab)}")
+
+    # warmup (compile)
+    engine.search_batch(queries[:BATCH], k=TOP_K)
+    t0 = time.perf_counter()
+    total = 0
+    for b in range(N_BATCHES):
+        chunk = queries[b * BATCH:(b + 1) * BATCH]
+        engine.search_batch(chunk, k=TOP_K)
+        total += len(chunk)
+    qps = total / (time.perf_counter() - t0)
+    log(f"[tpu] {total} queries -> {qps:.1f} q/s (batch={BATCH})")
+    return qps, len(texts) / index_s
+
+
+def bench_cpu_baseline(texts: list[str], queries: list[str]) -> float:
+    """Same scoring math, vectorized numpy on host CPU."""
+    from tfidf_tpu.ops.analyzer import Analyzer
+
+    analyzer = Analyzer()
+    vocab: dict[str, int] = {}
+    rows, cols, vals, lengths = [], [], [], []
+    for i, text in enumerate(texts):
+        counts = analyzer.counts(text)
+        lengths.append(float(sum(counts.values())))
+        for t, c in counts.items():
+            tid = vocab.setdefault(t, len(vocab))
+            rows.append(i)
+            cols.append(tid)
+            vals.append(float(c))
+    n_docs = len(texts)
+    V = len(vocab)
+    row = np.asarray(rows, np.int32)
+    col = np.asarray(cols, np.int32)
+    tf = np.asarray(vals, np.float32)
+    dl = np.asarray(lengths, np.float32)
+    df = np.bincount(col, minlength=V).astype(np.float32)
+    avgdl = dl.mean()
+    k1, b = 1.2, 0.75
+    idf = np.log1p((n_docs - df + 0.5) / (df + 0.5))
+    # precompute per-entry BM25 impact (generous to the baseline: the TPU
+    # side recomputes weights per query batch)
+    denom = tf + k1 * (1 - b + b * dl[row] / avgdl)
+    impact = (idf[col] * tf / denom).astype(np.float32)
+
+    def run_batch(qs: list[str]) -> np.ndarray:
+        B = len(qs)
+        qmat = np.zeros((B, V), np.float32)
+        for i, q in enumerate(qs):
+            for t, c in analyzer.counts(q).items():
+                tid = vocab.get(t)
+                if tid is not None:
+                    qmat[i, tid] += c
+        contrib = impact[None, :] * qmat[:, col]          # [B, nnz]
+        scores = np.zeros((B, n_docs), np.float32)
+        for i in range(B):
+            np.add.at(scores[i], row, contrib[i])
+        top = np.argpartition(-scores, TOP_K, axis=1)[:, :TOP_K]
+        return top
+
+    run_batch(queries[:BATCH])   # warm caches
+    t0 = time.perf_counter()
+    total = 0
+    for bidx in range(CPU_BATCHES):
+        chunk = queries[bidx * BATCH:(bidx + 1) * BATCH]
+        run_batch(chunk)
+        total += len(chunk)
+    qps = total / (time.perf_counter() - t0)
+    log(f"[cpu] {total} queries -> {qps:.1f} q/s (numpy baseline)")
+    return qps
+
+
+def main() -> None:
+    rng = np.random.default_rng(SEED)
+    t0 = time.perf_counter()
+    texts = make_corpus(rng)
+    queries = make_queries(rng, VOCAB, BATCH * N_BATCHES)
+    log(f"[gen] corpus+queries in {time.perf_counter()-t0:.1f}s")
+
+    tpu_qps, index_dps = bench_tpu(texts, queries)
+    cpu_qps = bench_cpu_baseline(texts, queries)
+
+    result = {
+        "metric": "bm25_batched_query_qps_18k_docs",
+        "value": round(tpu_qps, 2),
+        "unit": "queries/sec",
+        "vs_baseline": round(tpu_qps / cpu_qps, 2),
+        "extra": {
+            "indexing_docs_per_sec": round(index_dps, 1),
+            "cpu_baseline_qps": round(cpu_qps, 2),
+            "batch": BATCH,
+            "top_k": TOP_K,
+            "n_docs": N_DOCS,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -143,3 +143,62 @@ def test_sharded_cosine_model(rng):
     got = np.asarray(vals)[0]
     got = got[np.isfinite(got) & (got > 0)]
     np.testing.assert_allclose(np.sort(got)[::-1], top, rtol=1e-4)
+
+
+def test_sharded_ingest_then_search(rng):
+    """On-device index growth: append new docs, global IDF/avgdl shift, and
+    search must match the oracle over the combined corpus."""
+    from tfidf_tpu.parallel.sharded import build_ingest_batch, make_sharded_ingest
+
+    docs, lengths, shard = _shard(rng, n_docs=20, vocab=30)
+    D, T = 4, 2
+    mesh = make_mesh((D, T))
+    arrays = build_sharded_arrays(shard, mesh, min_chunk_cap=256)
+    ingest = make_sharded_ingest(mesh)
+
+    new_docs, new_lengths = random_corpus(rng, n_docs=8, vocab=30)
+    assign = shard_documents(len(docs), D)
+    n_live_before = [int((assign == s).sum()) for s in range(D)]
+    # place new docs round-robin too (continuing the pattern)
+    per_shard_docs = [[] for _ in range(D)]
+    per_shard_lens = [[] for _ in range(D)]
+    placement = []
+    for i, (d_counts, dl) in enumerate(zip(new_docs, new_lengths)):
+        s = i % D
+        placement.append((s, n_live_before[s] + len(per_shard_docs[s])))
+        per_shard_docs[s].append(d_counts)
+        per_shard_lens[s].append(dl)
+    batch = build_ingest_batch(mesh, arrays, per_shard_docs, per_shard_lens,
+                               64)
+    arrays2 = ingest(arrays, *batch)
+
+    # combined-corpus oracle
+    all_docs = docs + new_docs
+    all_lens = lengths + new_lengths
+    q = {1: 1.0, 3: 2.0}
+    qt, qw = _queries([q])
+    search = make_sharded_search(mesh, k=15, model="bm25", chunk=64)
+    vals, gids = search(arrays2, qt, qw)
+    want = np.asarray(bm25_scores(all_docs, all_lens, q))
+
+    # build global-id map: old docs then new placements
+    local_of = {}
+    counters = [0] * D
+    for g, s in enumerate(assign):
+        local_of[(int(s), counters[s])] = g
+        counters[s] += 1
+    for i, (s, local) in enumerate(placement):
+        local_of[(s, local)] = len(docs) + i
+
+    n_pos = int((want > 0).sum())
+    kk = min(15, n_pos)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(vals)[0, :kk])[::-1],
+        np.sort(want[np.argsort(-want)[:kk]])[::-1], rtol=1e-4)
+    for v, gid in zip(np.asarray(vals)[0], np.asarray(gids)[0]):
+        if np.isfinite(v) and v > 0:
+            s, local = divmod(int(gid), arrays.doc_cap)
+            np.testing.assert_allclose(v, want[local_of[(s, local)]],
+                                       rtol=1e-4, atol=1e-6)
+    # new docs are actually findable
+    assert int(np.asarray(arrays2.n_live).sum()) == len(all_docs)

@@ -172,7 +172,9 @@ class ShardIndex:
         doc_cap = next_capacity(max(n_live, 1), self.min_doc_cap)
         tf = np.zeros(nnz_cap, np.float32)
         term = np.zeros(nnz_cap, np.int32)
-        doc = np.zeros(nnz_cap, np.int32)
+        # padding rows point at doc_cap-1 to keep `doc` non-decreasing (the
+        # indices_are_sorted contract of the scoring segment-sums)
+        doc = np.full(nnz_cap, doc_cap - 1, np.int32)
         if nnz:
             tf[:nnz] = np.concatenate([d.tfs for d in live])
             term[:nnz] = np.concatenate([d.term_ids for d in live])

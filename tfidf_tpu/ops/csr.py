@@ -16,7 +16,10 @@ Why padded capacities: XLA traces once per shape, so every capacity is drawn
 from power-of-two buckets — appending documents reuses the compiled scoring
 executable until a bucket overflows (the analog of Lucene's segment growth,
 ``Worker.java:88,138``). Padding is inert by construction: padded ``tf`` is 0
-so scoring contributions vanish, padded ``doc`` points at row 0 harmlessly.
+so scoring contributions vanish, and padded ``doc`` is ``doc_cap - 1`` (the
+highest row) so the whole array stays genuinely non-decreasing — required
+because scoring passes ``indices_are_sorted=True`` to its segment-sums,
+which is undefined behavior in XLA if violated.
 
 Host-side building is numpy; arrays move to device once per commit.
 """
@@ -93,7 +96,7 @@ def build_coo(doc_counts: Sequence[dict[int, int]],
 
     tf = np.zeros(nnz_cap, np.float32)
     term = np.zeros(nnz_cap, np.int32)
-    doc = np.zeros(nnz_cap, np.int32)
+    doc = np.full(nnz_cap, doc_cap - 1, np.int32)   # sorted-padding
     doc_len = np.zeros(doc_cap, np.float32)
     df = np.zeros(vocab_cap, np.float32)
 
@@ -132,7 +135,7 @@ def merge_coo(shards: Sequence[CooShard],
 
     tf = np.zeros(nnz_cap, np.float32)
     term = np.zeros(nnz_cap, np.int32)
-    doc = np.zeros(nnz_cap, np.int32)
+    doc = np.full(nnz_cap, doc_cap - 1, np.int32)   # sorted-padding
     doc_len = np.zeros(doc_cap, np.float32)
     df = np.zeros(vocab_cap, np.float32)
 

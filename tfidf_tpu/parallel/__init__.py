@@ -2,7 +2,9 @@ from tfidf_tpu.parallel.mesh import make_mesh, default_mesh_shape
 from tfidf_tpu.parallel.sharded import (
     ShardedArrays,
     build_sharded_arrays,
+    build_ingest_batch,
     make_sharded_search,
+    make_sharded_ingest,
     global_stats,
 )
 
@@ -11,6 +13,8 @@ __all__ = [
     "default_mesh_shape",
     "ShardedArrays",
     "build_sharded_arrays",
+    "build_ingest_batch",
     "make_sharded_search",
+    "make_sharded_ingest",
     "global_stats",
 ]

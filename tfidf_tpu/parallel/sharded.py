@@ -54,6 +54,7 @@ class ShardedArrays:
     doc_len: jax.Array   # f32 [D, doc_cap]
     df: jax.Array        # f32 [D, T, vocab_cap] (per-shard partial df)
     n_live: jax.Array    # i32 [D] live docs per docs-shard
+    nnz_used: jax.Array  # i32 [D, T] entries in use per block (append cursor)
     doc_cap: int
     vocab_cap: int
 
@@ -64,9 +65,18 @@ class ShardedArrays:
 
 jax.tree_util.register_dataclass(
     ShardedArrays,
-    data_fields=["tf", "term", "doc", "doc_len", "df", "n_live"],
+    data_fields=["tf", "term", "doc", "doc_len", "df", "n_live", "nnz_used"],
     meta_fields=["doc_cap", "vocab_cap"],
 )
+
+
+def _split_ranges(k: int, t_parts: int) -> list[tuple[int, int]]:
+    """Contiguous ceil-split of k entries over t_parts terms blocks — the
+    single source of truth for the entry partition (build and ingest must
+    agree or append cursors desync from the layout)."""
+    step = -(-k // t_parts) if k else 0
+    return [(min(t * step, k), min((t + 1) * step, k))
+            for t in range(t_parts)]
 
 
 def shard_documents(n_docs: int, n_shards: int) -> np.ndarray:
@@ -117,16 +127,16 @@ def build_sharded_arrays(shard: CooShard,
 
     g_tf = np.zeros((D, T, chunk_cap), np.float32)
     g_term = np.zeros((D, T, chunk_cap), np.int32)
-    g_doc = np.zeros((D, T, chunk_cap), np.int32)
+    # sorted-padding: free entries point at the last row (zero contribution)
+    g_doc = np.full((D, T, chunk_cap), doc_cap - 1, np.int32)
     g_len = np.zeros((D, doc_cap), np.float32)
     g_df = np.zeros((D, T, vocab_cap), np.float32)
+    g_used = np.zeros((D, T), np.int32)
     for s in range(D):
         stf, sterm, sdoc = per_shard[s]
-        k = stf.shape[0]
-        for t in range(T):
-            lo = t * -(-k // T) if k else 0
-            hi = min(k, (t + 1) * -(-k // T)) if k else 0
-            n = max(hi - lo, 0)
+        for t, (lo, hi) in enumerate(_split_ranges(stf.shape[0], T)):
+            n = hi - lo
+            g_used[s, t] = n
             if n > 0:
                 g_tf[s, t, :n] = stf[lo:hi]
                 g_term[s, t, :n] = sterm[lo:hi]
@@ -148,6 +158,7 @@ def build_sharded_arrays(shard: CooShard,
         doc_len=put(g_len, P("docs", None)),
         df=put(g_df, P("docs", "terms", None)),
         n_live=put(counts.astype(np.int32), P("docs")),
+        nnz_used=put(g_used, P("docs", "terms")),
         doc_cap=doc_cap,
         vocab_cap=vocab_cap,
     )
@@ -245,3 +256,161 @@ def make_sharded_search(mesh: Mesh,
                        arrays.df, arrays.n_live, q_terms, q_weights)
 
     return search
+
+
+def build_ingest_batch(mesh: Mesh,
+                       arrays: ShardedArrays,
+                       new_docs_per_shard: list[list[dict[int, int]]],
+                       lengths_per_shard: list[list[float]],
+                       batch_chunk_cap: int):
+    """Vectorize new documents into a device-ready ingest batch.
+
+    ``new_docs_per_shard[d]`` holds the new docs placed on docs-shard d
+    (already chosen by the balancer); they get local ids continuing after
+    the shard's current live count. Entries are split over the terms axis
+    the same way as the initial build (contiguous chunks).
+
+    Raises if any block's free tail cannot hold a full batch window —
+    ``dynamic_update_slice`` silently clamps out-of-range starts, so an
+    oversized append would otherwise corrupt the front of the arrays.
+    """
+    D = mesh.shape["docs"]
+    T = mesh.shape["terms"]
+    C = batch_chunk_cap
+    doc_cap = arrays.doc_cap
+    chunk_cap = arrays.tf.shape[-1]
+    used_now = np.asarray(arrays.nnz_used)
+    if int(used_now.max()) + C > chunk_cap:
+        raise ValueError(
+            f"ingest batch (cap {C}) does not fit free tail "
+            f"(used max {int(used_now.max())} of {chunk_cap}); "
+            "compact/re-shard with a larger nnz capacity first")
+    n_live_before = [int(x) for x in np.asarray(arrays.n_live)]
+    max_new = max((len(d) for d in new_docs_per_shard), default=0)
+    L = next_capacity(max(max_new, 1), 8)   # O(batch), not O(doc_cap)
+    if max(n_live_before) + L > doc_cap:
+        raise ValueError("docs-shard over doc capacity; re-shard")
+    new_tf = np.zeros((D, T, C), np.float32)
+    new_term = np.zeros((D, T, C), np.int32)
+    new_doc = np.full((D, T, C), doc_cap - 1, np.int32)   # sorted-padding
+    new_count = np.zeros((D, T), np.int32)
+    new_len = np.zeros((D, L), np.float32)
+    new_docs = np.zeros(D, np.int32)
+    for d in range(D):
+        docs = new_docs_per_shard[d]
+        lens = lengths_per_shard[d]
+        tfs, terms, rows = [], [], []
+        for i, counts in enumerate(docs):
+            local = n_live_before[d] + i
+            new_len[d, i] = lens[i]
+            for t, f in sorted(counts.items()):
+                terms.append(t)
+                tfs.append(float(f))
+                rows.append(local)
+        new_docs[d] = len(docs)
+        for t, (lo, hi) in enumerate(_split_ranges(len(tfs), T)):
+            n = hi - lo
+            if n > C:
+                raise ValueError("ingest batch over chunk capacity")
+            if n:
+                new_tf[d, t, :n] = tfs[lo:hi]
+                new_term[d, t, :n] = terms[lo:hi]
+                new_doc[d, t, :n] = rows[lo:hi]
+            new_count[d, t] = n
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return (put(new_tf, P("docs", "terms", None)),
+            put(new_term, P("docs", "terms", None)),
+            put(new_doc, P("docs", "terms", None)),
+            put(new_count, P("docs", "terms")),
+            put(new_len, P("docs", None)),
+            put(new_docs, P("docs")))
+
+
+def make_sharded_ingest(mesh: Mesh):
+    """Build the jitted distributed ingest step — on-device index growth.
+
+    The streaming analog of the reference's upload path (file -> chosen
+    worker -> index + commit, ``Leader.java:153-207`` / ``Worker.java:125-
+    146``), but batched: each docs-shard receives a block of new postings
+    (host-vectorized, already placed by the balancer) and appends them into
+    its device arrays without recompilation or host round-trips:
+
+        tf/term/doc: dynamic-update-slice at the shard's append cursor
+        df:          += segment-sum of the new entries
+        doc_len:     new lengths written at the live cursor (new local ids
+                     are contiguous from n_live, so the delta is O(batch))
+        n_live:      += new document count
+
+    New-entry padding must be tf 0 / term 0 / doc ``doc_cap - 1`` (the
+    sorted-padding convention) — writing those into the free region is a
+    no-op by construction. Overflowing a capacity bucket is the host's job
+    to detect (re-shard with bigger caps).
+
+    Returned callable:
+        ingest(arrays, new_tf [D,T,C], new_term, new_doc, new_count [D,T],
+               new_len [D,L], new_docs [D]) -> ShardedArrays
+    """
+
+    def step(tf, term, doc, doc_len, df, n_live, nnz_used,
+             new_tf, new_term, new_doc, new_count, new_len, new_docs):
+        tf = tf.reshape(tf.shape[-1])
+        term = term.reshape(term.shape[-1])
+        doc = doc.reshape(doc.shape[-1])
+        doc_len = doc_len.reshape(doc_len.shape[-1])
+        df = df.reshape(df.shape[-1])
+        n_live = n_live.reshape(())
+        used = nnz_used.reshape(())
+        new_tf = new_tf.reshape(new_tf.shape[-1])
+        new_term = new_term.reshape(new_term.shape[-1])
+        new_doc = new_doc.reshape(new_doc.shape[-1])
+        new_count = new_count.reshape(())
+        new_len = new_len.reshape(new_len.shape[-1])
+        new_docs = new_docs.reshape(())
+
+        vocab_cap = df.shape[0]
+        tf2 = jax.lax.dynamic_update_slice(tf, new_tf, (used,))
+        term2 = jax.lax.dynamic_update_slice(term, new_term, (used,))
+        doc2 = jax.lax.dynamic_update_slice(doc, new_doc, (used,))
+        df2 = df + jax.ops.segment_sum(
+            (new_tf > 0).astype(jnp.float32), new_term,
+            num_segments=vocab_cap)
+        # new docs occupy the contiguous range starting at the live cursor;
+        # their prior lengths are zero, so an overwrite == an add
+        doc_len2 = jax.lax.dynamic_update_slice(doc_len, new_len, (n_live,))
+        n2 = n_live + new_docs
+        used2 = used + new_count
+        return (tf2[None, None], term2[None, None], doc2[None, None],
+                doc_len2[None], df2[None, None], n2[None], used2[None, None])
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("docs", "terms", None), P("docs", "terms", None),
+                  P("docs", "terms", None), P("docs", None),
+                  P("docs", "terms", None), P("docs"), P("docs", "terms"),
+                  P("docs", "terms", None), P("docs", "terms", None),
+                  P("docs", "terms", None), P("docs", "terms"),
+                  P("docs", None), P("docs")),
+        out_specs=(P("docs", "terms", None), P("docs", "terms", None),
+                   P("docs", "terms", None), P("docs", None),
+                   P("docs", "terms", None), P("docs"),
+                   P("docs", "terms")),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def ingest(arrays: ShardedArrays, new_tf, new_term, new_doc, new_count,
+               new_len, new_docs):
+        tf, term, doc, doc_len, df, n_live, nnz_used = sharded(
+            arrays.tf, arrays.term, arrays.doc, arrays.doc_len, arrays.df,
+            arrays.n_live, arrays.nnz_used,
+            new_tf, new_term, new_doc, new_count, new_len, new_docs)
+        return ShardedArrays(
+            tf=tf, term=term, doc=doc, doc_len=doc_len, df=df,
+            n_live=n_live, nnz_used=nnz_used,
+            doc_cap=arrays.doc_cap, vocab_cap=arrays.vocab_cap)
+
+    return ingest

@@ -1,0 +1,345 @@
+"""Cluster tests: election, registry, and the full multi-node HTTP system.
+
+The multi-node behavior the reference only ever validated manually
+(SURVEY.md §4: run several instances + curl) is automated here: a 3-node
+in-process cluster with a real HTTP data plane, exercising scatter-gather
+search, least-loaded upload placement, download probing, leader failover,
+and partial-result tolerance.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tfidf_tpu.cluster.coordination import CoordinationCore, LocalCoordination
+from tfidf_tpu.cluster.election import LeaderElection
+from tfidf_tpu.cluster.node import SearchNode, http_get, http_post
+from tfidf_tpu.cluster.registry import (ServiceRegistry, read_leader_info)
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.faults import global_injector
+
+
+def wait_until(pred, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture
+def core():
+    c = CoordinationCore(session_timeout_s=0.5)
+    yield c
+    c.close()
+
+
+class Recorder:
+    """OnElectionCallback that records role transitions."""
+
+    def __init__(self):
+        self.roles = []
+
+    def on_elected_to_be_leader(self):
+        self.roles.append("leader")
+
+    def on_worker(self):
+        self.roles.append("worker")
+
+
+class TestElection:
+    def test_smallest_wins_and_failover(self, core):
+        clients = [LocalCoordination(core, 0.1) for _ in range(3)]
+        recs = [Recorder() for _ in range(3)]
+        elections = []
+        try:
+            for c, r in zip(clients, recs):
+                e = LeaderElection(c, r)
+                e.volunteer_for_leadership()
+                e.reelect_leader()
+                elections.append(e)
+            assert elections[0].is_leader()
+            assert not elections[1].is_leader()
+            assert recs[0].roles == ["leader"]
+            assert recs[1].roles == ["worker"]
+
+            # leader dies → successor (smallest remaining) is promoted
+            core.expire_session(clients[0].sid)
+            assert wait_until(lambda: recs[1].roles[-1] == "leader")
+            assert elections[1].is_leader()
+            assert recs[2].roles == ["worker"]   # non-successor undisturbed
+        finally:
+            for c in clients:
+                c.close()
+
+    def test_middle_death_rewires_watch_chain(self, core):
+        """When a non-leader dies, its successor re-watches the new
+        predecessor without a leadership change (LeaderElection.java:57-86:
+        each node watches only its immediate predecessor)."""
+        clients = [LocalCoordination(core, 0.1) for _ in range(3)]
+        recs = [Recorder() for _ in range(3)]
+        elections = []
+        try:
+            for c, r in zip(clients, recs):
+                e = LeaderElection(c, r)
+                e.volunteer_for_leadership()
+                e.reelect_leader()
+                elections.append(e)
+            core.expire_session(clients[1].sid)   # middle node dies
+            # node 2 re-elects, stays a worker
+            assert wait_until(lambda: len(recs[2].roles) == 2)
+            assert recs[2].roles == ["worker", "worker"]
+            assert elections[0].is_leader()
+            # now the old leader dies → node 2 must be promoted (proves the
+            # watch was correctly rewired to node 0)
+            core.expire_session(clients[0].sid)
+            assert wait_until(lambda: recs[2].roles[-1] == "leader")
+        finally:
+            for c in clients:
+                c.close()
+
+
+class TestRejoin:
+    def test_worker_rejoins_after_session_expiry(self, core, tmp_path):
+        """A node whose coordination session expires reconnects with a
+        fresh session and re-enters the cluster — a capability the
+        reference lacks (an expired pod stays out until restarted)."""
+        def factory():
+            return LocalCoordination(core, 0.1)
+
+        nodes = []
+        try:
+            for i in range(2):
+                cfg = Config(
+                    documents_path=str(tmp_path / f"rj{i}" / "docs"),
+                    index_path=str(tmp_path / f"rj{i}" / "index"),
+                    port=0, min_doc_capacity=64,
+                    min_nnz_capacity=1 << 12, min_vocab_capacity=1 << 10,
+                    query_batch=4, max_query_terms=8)
+                nodes.append(SearchNode(cfg, coord_factory=factory).start())
+            leader, worker = nodes
+            assert wait_until(lambda: leader.registry
+                              .get_all_service_addresses() == [worker.url])
+            old_sid = worker.coord.sid
+            core.expire_session(old_sid)
+            # the worker must come back on a FRESH session and re-register
+            assert wait_until(lambda: worker.coord.sid != old_sid,
+                              timeout=8.0)
+            assert wait_until(lambda: leader.registry
+                              .get_all_service_addresses() == [worker.url],
+                              timeout=8.0)
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+    def test_leader_info_survives_old_session_expiry(self, core):
+        """publish_leader_info must re-own /leader_info: if the new leader
+        merely setData'd the old leader's ephemeral node, the address would
+        vanish when the old session expires."""
+        from tfidf_tpu.cluster.registry import publish_leader_info
+        old = LocalCoordination(core, 0.1)
+        new = LocalCoordination(core, 0.1)
+        try:
+            publish_leader_info(old, "http://old")
+            publish_leader_info(new, "http://new")
+            assert read_leader_info(new) == "http://new"
+            core.expire_session(old.sid)
+            time.sleep(0.3)   # old session's ephemerals reaped
+            assert read_leader_info(new) == "http://new"
+        finally:
+            old.close()
+            new.close()
+
+
+class TestRegistry:
+    def test_register_discover_unregister(self, core):
+        a, b = LocalCoordination(core, 0.1), LocalCoordination(core, 0.1)
+        try:
+            ra, rb = ServiceRegistry(a), ServiceRegistry(b)
+            ra.register_to_cluster("http://w0:1")
+            rb.register_for_updates()
+            assert wait_until(
+                lambda: rb.get_all_service_addresses() == ["http://w0:1"])
+            ra.unregister_from_cluster()
+            assert wait_until(lambda: rb.get_all_service_addresses() == [])
+        finally:
+            a.close()
+            b.close()
+
+    def test_dead_worker_disappears(self, core):
+        a, b = LocalCoordination(core, 0.1), LocalCoordination(core, 0.1)
+        try:
+            ra, rb = ServiceRegistry(a), ServiceRegistry(b)
+            ra.register_to_cluster("http://w0:1")
+            rb.register_for_updates()
+            assert wait_until(
+                lambda: rb.get_all_service_addresses() == ["http://w0:1"])
+            core.expire_session(a.sid)   # worker crash
+            assert wait_until(lambda: rb.get_all_service_addresses() == [])
+        finally:
+            a.close()
+            b.close()
+
+
+@pytest.fixture
+def cluster(core, tmp_path):
+    """A 3-node cluster on localhost with a real HTTP data plane."""
+    nodes = []
+    for i in range(3):
+        cfg = Config(
+            documents_path=str(tmp_path / f"node{i}" / "documents"),
+            index_path=str(tmp_path / f"node{i}" / "index"),
+            port=0, result_order="name",
+            min_doc_capacity=64, min_nnz_capacity=1 << 12,
+            min_vocab_capacity=1 << 10, query_batch=4, max_query_terms=8)
+        node = SearchNode(cfg, coord=LocalCoordination(core, 0.1))
+        node.start()
+        nodes.append(node)
+    # node 0 is leader (smallest sequence number); 1 and 2 are workers
+    wait_until(lambda: len(
+        nodes[0].registry.get_all_service_addresses()) == 2)
+    yield nodes
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+class TestClusterEndToEnd:
+    def test_roles_and_status(self, cluster, core):
+        leader = cluster[0]
+        assert leader.is_leader()
+        assert http_get(leader.url + "/api/status") == b"I am the leader"
+        assert http_get(cluster[1].url +
+                        "/api/status") == b"I am a worker node"
+        # leader is not in the worker pool (OnElectionAction.java:30)
+        addrs = json.loads(http_get(leader.url + "/api/services"))
+        assert sorted(addrs) == sorted([cluster[1].url, cluster[2].url])
+        assert read_leader_info(leader.coord) == leader.url
+
+    def test_upload_search_download_cycle(self, cluster):
+        leader = cluster[0]
+        docs = {
+            "a.txt": b"the quick brown fox jumps over the lazy dog",
+            "b.txt": b"a fast brown fox and a quick red fox",
+            "c.txt": b"lorem ipsum dolor sit amet",
+            "d.txt": b"the dog sleeps all day long",
+        }
+        for name, data in docs.items():
+            resp = http_post(leader.url + f"/leader/upload?name={name}",
+                             data, content_type="application/octet-stream")
+            assert b"uploaded successfully" in resp
+
+        # scatter-gather search, sum-merged, name-ordered (parity mode)
+        result = json.loads(http_post(leader.url + "/leader/start",
+                                      json.dumps({"query": "fox"}).encode()))
+        assert set(result) == {"a.txt", "b.txt"}
+        assert list(result) == sorted(result)   # reference TreeMap order
+        assert all(v > 0 for v in result.values())
+        # b.txt mentions fox twice → higher score
+        assert result["b.txt"] > result["a.txt"]
+
+        # download: leader probes workers for the document (Leader.java:127)
+        got = http_get(leader.url + "/leader/download?path=c.txt")
+        assert got == docs["c.txt"]
+
+        # load balancing spread documents over both workers
+        sizes = [int(http_get(w + "/worker/index-size"))
+                 for w in json.loads(http_get(leader.url + "/api/services"))]
+        assert all(s > 0 for s in sizes)
+
+    def test_multipart_upload(self, cluster):
+        leader = cluster[0]
+        boundary = "XbOuNdArYX"
+        body = (
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="file"; '
+            'filename="multi.txt"\r\n'
+            "Content-Type: text/plain\r\n\r\n"
+            "zebra stripes pattern\r\n"
+            f"--{boundary}--\r\n").encode()
+        resp = http_post(
+            leader.url + "/leader/upload", body,
+            content_type=f"multipart/form-data; boundary={boundary}")
+        assert b"uploaded successfully" in resp
+        result = json.loads(http_post(
+            leader.url + "/leader/start",
+            json.dumps({"query": "zebra"}).encode()))
+        assert "multi.txt" in result
+
+    def test_download_traversal_rejected(self, cluster):
+        worker = cluster[1]
+        req = urllib.request.Request(
+            worker.url + "/worker/download?path=..%2F..%2Fetc%2Fpasswd")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+
+    def test_partial_results_on_worker_failure(self, cluster):
+        """Per-worker failure tolerance (Leader.java:67-69): killing one
+        worker must not break search; the other shard still answers."""
+        leader = cluster[0]
+        for name, text in [("x.txt", b"alpha beta"), ("y.txt", b"alpha gq")]:
+            http_post(leader.url + f"/leader/upload?name={name}", text,
+                      content_type="application/octet-stream")
+        cluster[2].httpd.shutdown()   # data plane down, session still alive
+        cluster[2].httpd.server_close()   # refuse new connections promptly
+        result = json.loads(http_post(
+            leader.url + "/leader/start",
+            json.dumps({"query": "alpha"}).encode()))
+        # at least the surviving worker's shard answered
+        assert len(result) >= 1
+
+    def test_leader_failover_end_to_end(self, cluster, core):
+        """Kill the leader: a worker is promoted, publishes /leader_info,
+        leaves the worker pool, and serves searches."""
+        old_leader, w1 = cluster[0], cluster[1]
+        http_post(old_leader.url + "/leader/upload?name=z.txt",
+                  b"gamma delta", content_type="application/octet-stream")
+        core.expire_session(old_leader.coord.sid)
+        assert wait_until(lambda: w1.is_leader(), timeout=5.0)
+        assert wait_until(
+            lambda: read_leader_info(w1.coord) == w1.url, timeout=5.0)
+        # new leader left the worker pool; only w2 remains registered
+        assert wait_until(lambda: w1.registry.get_all_service_addresses()
+                          == [cluster[2].url], timeout=5.0)
+        result = json.loads(http_post(
+            w1.url + "/leader/start",
+            json.dumps({"query": "gamma"}).encode()))
+        assert isinstance(result, dict)
+
+    def test_fault_injection_on_scatter(self, cluster):
+        """Armed fault point drops every worker RPC → empty results, no
+        error (the reference's swallow-and-continue semantics)."""
+        leader = cluster[0]
+        http_post(leader.url + "/leader/upload?name=f.txt", b"epsilon zeta",
+                  content_type="application/octet-stream")
+        global_injector.arm("leader.worker_rpc", action="raise")
+        try:
+            result = json.loads(http_post(
+                leader.url + "/leader/start",
+                json.dumps({"query": "epsilon"}).encode()))
+            assert result == {}
+        finally:
+            global_injector.disarm("leader.worker_rpc")
+
+    def test_leader_download_traversal_rejected(self, cluster):
+        req = urllib.request.Request(
+            cluster[0].url + "/leader/download?path=..%2F..%2Fetc%2Fpasswd")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+
+    def test_metrics_exposed(self, cluster):
+        leader = cluster[0]
+        http_post(leader.url + "/leader/upload?name=m.txt", b"metric text",
+                  content_type="application/octet-stream")
+        snap = json.loads(http_get(leader.url + "/api/metrics"))
+        assert snap.get("uploads_placed", 0) >= 1

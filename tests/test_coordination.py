@@ -1,0 +1,211 @@
+"""Coordination substrate tests: znode semantics, sessions, watches.
+
+Covers the four ZooKeeper primitives the reference relies on (SURVEY.md §2):
+persistent/ephemeral/ephemeral-sequential nodes, data payloads, one-shot
+watches, and session-timeout liveness — over both the in-process and the
+HTTP transports.
+"""
+
+import time
+
+import pytest
+
+from tfidf_tpu.cluster.coordination import (
+    CHILDREN_CHANGED, EPHEMERAL, EPHEMERAL_SEQUENTIAL, NODE_DELETED,
+    CoordinationCore, CoordinationServer, CoordinationClient,
+    LocalCoordination, NodeExistsError, NoNodeError)
+
+
+def wait_until(pred, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture
+def core():
+    c = CoordinationCore(session_timeout_s=0.5)
+    yield c
+    c.close()
+
+
+@pytest.fixture
+def local(core):
+    clients = []
+
+    def make():
+        cl = LocalCoordination(core, heartbeat_interval_s=0.1)
+        clients.append(cl)
+        return cl
+
+    yield make
+    for cl in clients:
+        cl.close()
+
+
+class TestTree:
+    def test_create_get_set_delete(self, local):
+        c = local()
+        c.create("/a", b"hello")
+        assert c.exists("/a")
+        assert c.get_data("/a") == b"hello"
+        c.set_data("/a", b"world")
+        assert c.get_data("/a") == b"world"
+        c.delete("/a")
+        assert not c.exists("/a")
+
+    def test_duplicate_create_raises(self, local):
+        c = local()
+        c.create("/a")
+        with pytest.raises(NodeExistsError):
+            c.create("/a")
+        c.ensure("/a")   # create-if-absent does not raise
+
+    def test_missing_parent_and_node(self, local):
+        c = local()
+        with pytest.raises(NoNodeError):
+            c.create("/a/b")
+        with pytest.raises(NoNodeError):
+            c.get_data("/nope")
+        with pytest.raises(NoNodeError):
+            c.delete("/nope")
+
+    def test_sequential_naming(self, local):
+        """EPHEMERAL_SEQUENTIAL appends a monotonically increasing zero-
+        padded counter, like ZooKeeper's c_0000000000 naming that the
+        election sorts on (LeaderElection.java:60-63)."""
+        c = local()
+        c.create("/election")
+        p0 = c.create("/election/c_", mode=EPHEMERAL_SEQUENTIAL)
+        p1 = c.create("/election/c_", mode=EPHEMERAL_SEQUENTIAL)
+        assert p0 == "/election/c_0000000000"
+        assert p1 == "/election/c_0000000001"
+        assert c.get_children("/election") == ["c_0000000000",
+                                               "c_0000000001"]
+
+    def test_children_sorted(self, local):
+        c = local()
+        c.create("/r")
+        for name in ["b", "a", "c"]:
+            c.create(f"/r/{name}")
+        assert c.get_children("/r") == ["a", "b", "c"]
+
+
+class TestSessions:
+    def test_ephemeral_vanishes_on_close(self, local):
+        c1, c2 = local(), local()
+        c1.create("/svc")
+        c1.create("/svc/n_", b"addr", mode=EPHEMERAL_SEQUENTIAL)
+        assert c2.get_children("/svc") != []
+        c1.close()
+        assert wait_until(lambda: c2.get_children("/svc") == [])
+
+    def test_session_timeout_expires_ephemerals(self, core, local):
+        """A node that stops heartbeating is declared dead after the
+        session timeout — the reference's failure detector
+        (ZookeeperConfig.java:17, 3000ms; scaled down here)."""
+        c1, c2 = local(), local()
+        c1.create("/svc")
+        c1.create("/svc/n_", b"x", mode=EPHEMERAL)
+        # simulate a partitioned/crashed node: stop heartbeats
+        c1._closed.set()
+        assert wait_until(lambda: c2.get_children("/svc") == [],
+                          timeout=3.0)
+
+    def test_forced_expiry_fault_injection(self, core, local):
+        c1, c2 = local(), local()
+        c1.create("/svc")
+        c1.create("/svc/e", b"x", mode=EPHEMERAL)
+        core.expire_session(c1.sid)
+        assert wait_until(lambda: not c2.exists("/svc/e"))
+
+    def test_persistent_survives_session(self, local):
+        c1, c2 = local(), local()
+        c1.create("/keep", b"data")
+        c1.close()
+        time.sleep(0.1)
+        assert c2.get_data("/keep") == b"data"
+
+
+class TestWatches:
+    def test_deletion_watch_fires_once(self, local):
+        c1, c2 = local(), local()
+        c1.create("/t")
+        events = []
+        assert c1.exists("/t", watcher=events.append)
+        c2.delete("/t")
+        assert wait_until(lambda: len(events) == 1)
+        assert events[0].type == NODE_DELETED
+        assert events[0].path == "/t"
+        # one-shot: recreating and deleting again fires nothing new
+        c2.create("/t")
+        c2.delete("/t")
+        time.sleep(0.2)
+        assert len(events) == 1
+
+    def test_children_watch(self, local):
+        c1, c2 = local(), local()
+        c1.create("/r")
+        events = []
+        c1.get_children("/r", watcher=events.append)
+        c2.create("/r/x")
+        assert wait_until(lambda: len(events) == 1)
+        assert events[0].type == CHILDREN_CHANGED
+
+    def test_watch_rearm_pattern(self, local):
+        """The registry's pattern: refresh + re-arm inside the callback
+        (ServiceRegistry.java:91-122)."""
+        c1, c2 = local(), local()
+        c1.create("/r")
+        seen = []
+
+        def on_change(ev):
+            seen.append(c1.get_children("/r", watcher=on_change))
+
+        c1.get_children("/r", watcher=on_change)
+        c2.create("/r/a")
+        assert wait_until(lambda: len(seen) >= 1)
+        c2.create("/r/b")
+        assert wait_until(lambda: any("b" in s for s in seen))
+
+
+class TestHTTPTransport:
+    def test_full_stack_over_http(self):
+        # generous timeout: under full-suite load (JAX compiles hogging the
+        # GIL) heartbeat threads can stall well past a sub-second deadline
+        server = CoordinationServer(session_timeout_s=3.0).start()
+        try:
+            c1 = CoordinationClient(server.address,
+                                    heartbeat_interval_s=0.2)
+            c2 = CoordinationClient(server.address,
+                                    heartbeat_interval_s=0.2)
+            c1.create("/svc")
+            path = c1.create("/svc/n_", b"http://w0",
+                             mode=EPHEMERAL_SEQUENTIAL)
+            assert path == "/svc/n_0000000000"
+            assert c2.get_data(path) == b"http://w0"
+
+            events = []
+            c2.get_children("/svc", watcher=events.append)
+            c1.close()   # session close → ephemeral gone → watch fires
+            assert wait_until(lambda: len(events) >= 1, timeout=5.0)
+            assert c2.get_children("/svc") == []
+            c2.close()
+        finally:
+            server.close()
+
+    def test_http_errors_map_to_exceptions(self):
+        server = CoordinationServer(session_timeout_s=5.0).start()
+        try:
+            c = CoordinationClient(server.address, heartbeat_interval_s=0.5)
+            c.create("/a")
+            with pytest.raises(NodeExistsError):
+                c.create("/a")
+            with pytest.raises(NoNodeError):
+                c.get_data("/missing")
+            c.close()
+        finally:
+            server.close()

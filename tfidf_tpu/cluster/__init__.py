@@ -1,0 +1,32 @@
+"""Cluster control plane.
+
+The TPU-native replacement for the reference's coordination stack
+(SURVEY.md §1, layers L0-L3):
+
+- :mod:`coordination` — the L0 substrate: a small coordination service with
+  ZooKeeper's znode semantics (persistent / ephemeral / ephemeral-sequential
+  nodes, data payloads, one-shot watches, session-timeout liveness),
+  embeddable in-process or served over HTTP to many node processes.
+  Replaces the external ZooKeeper server (``config/ZookeeperConfig.java``).
+- :mod:`election` — L1 leader election with the reference's exact
+  predecessor-watch algorithm (``leader/LeaderElection.java``).
+- :mod:`registry` — L1 service discovery (``registry/ServiceRegistry.java``).
+- :mod:`node` — L2+L3: the symmetric node binary. Every node serves the
+  worker data-plane API; the elected leader additionally serves the
+  coordinator API (``leader/Leader.java``, ``worker/Worker.java``,
+  ``controller/Controllers.java``).
+"""
+
+from tfidf_tpu.cluster.coordination import (CoordinationCore,
+                                            CoordinationServer,
+                                            CoordinationClient,
+                                            LocalCoordination, Event)
+from tfidf_tpu.cluster.election import LeaderElection, OnElectionCallback
+from tfidf_tpu.cluster.registry import ServiceRegistry
+from tfidf_tpu.cluster.node import SearchNode
+
+__all__ = [
+    "CoordinationCore", "CoordinationServer", "CoordinationClient",
+    "LocalCoordination", "Event", "LeaderElection", "OnElectionCallback",
+    "ServiceRegistry", "SearchNode",
+]

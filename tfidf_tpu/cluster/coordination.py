@@ -1,0 +1,636 @@
+"""Coordination substrate — ZooKeeper's semantics, framework-native.
+
+The reference outsources coordination to an external ZooKeeper ensemble
+(``config/ZookeeperConfig.java:11-24``) and uses exactly four of its
+primitives (SURVEY.md §2, §5.8):
+
+1. persistent znodes as namespaces (``/election``, ``/service_registry`` —
+   ``LeaderElection.java:30-47``, ``ServiceRegistry.java:35-51``);
+2. EPHEMERAL and EPHEMERAL_SEQUENTIAL znodes with data payloads, whose
+   lifetime is the client session (``LeaderElection.java:49-55``,
+   ``ServiceRegistry.java:54-64``, ``OnElectionAction.java:45-54``);
+3. one-shot watches on node deletion and on a node's children
+   (``LeaderElection.java:100-113``, ``ServiceRegistry.java:91-122``);
+4. session timeout as the cluster failure detector (3000 ms,
+   ``ZookeeperConfig.java:17``).
+
+This module implements those four primitives directly so the framework has
+no external coordination dependency:
+
+- :class:`CoordinationCore` — the znode tree + sessions + watches, pure
+  in-process data structure (also the fake for tests, SURVEY.md §4).
+- :class:`CoordinationServer` — serves a core over HTTP/JSON so many node
+  processes share one substrate (the "zookeeper:2181" role). Events reach
+  clients via long-polling.
+- :class:`CoordinationClient` / :class:`LocalCoordination` — the client
+  bean (``ZookeeperConfig.zooKeeper()`` analog): same API over HTTP or
+  in-process, with automatic heartbeats and watch dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, NamedTuple
+from urllib.parse import parse_qs, urlparse
+
+from tfidf_tpu.utils.faults import global_injector
+from tfidf_tpu.utils.logging import get_logger
+
+log = get_logger("cluster.coordination")
+
+# Event types (names follow ZooKeeper's EventType for recognizability).
+NODE_CREATED = "NodeCreated"
+NODE_DELETED = "NodeDeleted"
+CHILDREN_CHANGED = "NodeChildrenChanged"
+SESSION_EXPIRED = "SessionExpired"
+
+PERSISTENT = "persistent"
+EPHEMERAL = "ephemeral"
+EPHEMERAL_SEQUENTIAL = "ephemeral_sequential"
+
+
+class Event(NamedTuple):
+    type: str
+    path: str
+
+
+class NodeExistsError(Exception):
+    pass
+
+
+class NoNodeError(Exception):
+    pass
+
+
+class _Znode:
+    __slots__ = ("data", "ephemeral_owner", "seq", "children")
+
+    def __init__(self, data: bytes = b"",
+                 ephemeral_owner: int | None = None) -> None:
+        self.data = data
+        self.ephemeral_owner = ephemeral_owner
+        self.seq = 0                      # next sequential-child counter
+        self.children: dict[str, _Znode] = {}
+
+
+class _Session:
+    __slots__ = ("id", "last_seen", "queue", "cond", "ephemerals", "expired")
+
+    def __init__(self, sid: int) -> None:
+        self.id = sid
+        self.last_seen = time.monotonic()
+        self.queue: deque[Event] = deque()
+        self.cond = threading.Condition()
+        self.ephemerals: set[str] = set()
+        self.expired = False
+
+
+def _split(path: str) -> list[str]:
+    parts = [p for p in path.split("/") if p]
+    if not path.startswith("/") or not parts:
+        raise ValueError(f"bad path {path!r}")
+    return parts
+
+
+class CoordinationCore:
+    """The znode tree. Thread-safe; transport-agnostic.
+
+    Watches are one-shot, exactly like ZooKeeper's: registering happens as a
+    side effect of a read (``exists``/``get_children``), firing consumes the
+    registration (the reference re-arms by re-reading —
+    ``ServiceRegistry.java:104``, ``LeaderElection.java:75``).
+    """
+
+    def __init__(self, session_timeout_s: float = 3.0) -> None:
+        self.session_timeout_s = session_timeout_s
+        self._root = _Znode()
+        self._lock = threading.RLock()
+        self._sessions: dict[int, _Session] = {}
+        self._next_sid = 1
+        # (path, kind) -> set of session ids; kind: "exists" | "children"
+        self._watches: dict[tuple[str, str], set[int]] = {}
+        self._closed = False
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
+                                        name="coord-reaper")
+        self._reaper.start()
+
+    # ---- sessions ----
+
+    def new_session(self) -> int:
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+            self._sessions[sid] = _Session(sid)
+            return sid
+
+    def heartbeat(self, sid: int) -> bool:
+        """Refresh liveness; False if the session is gone (client must
+        treat this like an expired ZooKeeper session)."""
+        global_injector.check(f"coord.heartbeat.{sid}")
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is None:
+                return False
+            s.last_seen = time.monotonic()
+            return True
+
+    def close_session(self, sid: int) -> None:
+        with self._lock:
+            self._expire_locked(sid, reason="closed")
+
+    def expire_session(self, sid: int) -> None:
+        """Force-expire (fault injection: simulates a node partition)."""
+        with self._lock:
+            self._expire_locked(sid, reason="forced")
+
+    def _expire_locked(self, sid: int, reason: str) -> None:
+        s = self._sessions.pop(sid, None)
+        if s is None:
+            return
+        s.expired = True
+        for path in sorted(s.ephemerals, reverse=True):
+            try:
+                self._delete_locked(path)
+            except NoNodeError:
+                pass
+        for key in list(self._watches):
+            self._watches[key].discard(sid)
+            if not self._watches[key]:
+                del self._watches[key]
+        with s.cond:
+            s.queue.append(Event(SESSION_EXPIRED, ""))
+            s.cond.notify_all()
+        log.info("session expired", sid=sid, reason=reason)
+
+    def _reap_loop(self) -> None:
+        while not self._closed:
+            time.sleep(min(0.1, self.session_timeout_s / 4))
+            now = time.monotonic()
+            with self._lock:
+                dead = [sid for sid, s in self._sessions.items()
+                        if now - s.last_seen > self.session_timeout_s]
+                for sid in dead:
+                    self._expire_locked(sid, reason="timeout")
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            for sid in list(self._sessions):
+                self._expire_locked(sid, reason="shutdown")
+
+    # ---- tree ops ----
+
+    def _resolve(self, parts: list[str]) -> _Znode:
+        node = self._root
+        for p in parts:
+            node = node.children.get(p)
+            if node is None:
+                raise NoNodeError("/" + "/".join(parts))
+        return node
+
+    def create(self, sid: int, path: str, data: bytes = b"",
+               mode: str = PERSISTENT) -> str:
+        with self._lock:
+            parts = _split(path)
+            parent = self._resolve(parts[:-1])
+            name = parts[-1]
+            if mode == EPHEMERAL_SEQUENTIAL:
+                name = f"{name}{parent.seq:010d}"
+                parent.seq += 1
+            if name in parent.children:
+                raise NodeExistsError(path)
+            owner = sid if mode in (EPHEMERAL, EPHEMERAL_SEQUENTIAL) else None
+            parent.children[name] = _Znode(data, owner)
+            full = "/" + "/".join(parts[:-1] + [name])
+            if owner is not None:
+                s = self._sessions.get(sid)
+                if s is None:
+                    del parent.children[name]
+                    raise NoNodeError(f"session {sid} gone")
+                s.ephemerals.add(full)
+            parent_path = "/" + "/".join(parts[:-1]) if parts[:-1] else "/"
+            self._fire(full, "exists", NODE_CREATED)
+            self._fire(parent_path, "children", CHILDREN_CHANGED)
+            return full
+
+    def delete(self, sid: int, path: str) -> None:
+        with self._lock:
+            self._delete_locked(path)   # also clears the owner's ephemerals
+
+    def _delete_locked(self, path: str) -> None:
+        parts = _split(path)
+        parent = self._resolve(parts[:-1])
+        node = parent.children.pop(parts[-1], None)
+        if node is None:
+            raise NoNodeError(path)
+        if node.ephemeral_owner is not None:
+            s = self._sessions.get(node.ephemeral_owner)
+            if s is not None:
+                s.ephemerals.discard(path)
+        parent_path = "/" + "/".join(parts[:-1]) if parts[:-1] else "/"
+        self._fire(path, "exists", NODE_DELETED)
+        self._fire(parent_path, "children", CHILDREN_CHANGED)
+
+    def exists(self, sid: int, path: str, watch: bool = False) -> bool:
+        with self._lock:
+            try:
+                self._resolve(_split(path))
+                found = True
+            except NoNodeError:
+                found = False
+            if watch:
+                self._watches.setdefault((path, "exists"), set()).add(sid)
+            return found
+
+    def get_data(self, sid: int, path: str) -> bytes:
+        with self._lock:
+            return self._resolve(_split(path)).data
+
+    def set_data(self, sid: int, path: str, data: bytes) -> None:
+        with self._lock:
+            self._resolve(_split(path)).data = data
+
+    def get_children(self, sid: int, path: str,
+                     watch: bool = False) -> list[str]:
+        with self._lock:
+            if path == "/":
+                node = self._root
+            else:
+                node = self._resolve(_split(path))
+            if watch:
+                self._watches.setdefault((path, "children"), set()).add(sid)
+            return sorted(node.children)
+
+    # ---- watches ----
+
+    def _fire(self, path: str, kind: str, ev_type: str) -> None:
+        sids = self._watches.pop((path, kind), None)
+        if not sids:
+            return
+        ev = Event(ev_type, path)
+        for sid in sids:
+            s = self._sessions.get(sid)
+            if s is None:
+                continue
+            with s.cond:
+                s.queue.append(ev)
+                s.cond.notify_all()
+
+    def poll_events(self, sid: int, timeout_s: float) -> list[Event]:
+        with self._lock:
+            s = self._sessions.get(sid)
+        if s is None:
+            return [Event(SESSION_EXPIRED, "")]
+        with s.cond:
+            if not s.queue:
+                s.cond.wait(timeout_s)
+            evs = list(s.queue)
+            s.queue.clear()
+            return evs
+
+
+# --------------------------------------------------------------------------
+# Client API (shared by in-process and HTTP transports)
+# --------------------------------------------------------------------------
+
+Watcher = Callable[[Event], None]
+
+
+class _BaseCoordination:
+    """Watch registration + dispatch common to both transports.
+
+    A single dispatch thread delivers events to Python callbacks, mirroring
+    ZooKeeper's single event thread (so callbacks never race each other —
+    the property ``ServiceRegistry.updateAddresses``'s ``synchronized``
+    defends against is preserved by construction).
+    """
+
+    def __init__(self) -> None:
+        self._wlock = threading.Lock()
+        # (path, kind) -> list of watchers; one-shot, popped on fire
+        self._watchers: dict[tuple[str, str], list[Watcher]] = {}
+        self._session_watchers: list[Watcher] = []
+        self._closed = threading.Event()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="coord-dispatch")
+
+    def start(self) -> None:
+        self._dispatcher.start()
+
+    # transport hooks -----------------------------------------------------
+    def _poll(self, timeout_s: float) -> list[Event]:
+        raise NotImplementedError
+
+    # watch plumbing ------------------------------------------------------
+    def _arm(self, path: str, kind: str, watcher: Watcher | None) -> None:
+        if watcher is None:
+            return
+        with self._wlock:
+            self._watchers.setdefault((path, kind), []).append(watcher)
+
+    def on_session_event(self, watcher: Watcher) -> None:
+        """Persistent (not one-shot) session-state callback — the role of
+        the reference's ``Application.process`` watcher
+        (``app/Application.java:49-66``)."""
+        with self._wlock:
+            self._session_watchers.append(watcher)
+
+    def _dispatch_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                events = self._poll(timeout_s=1.0)
+            except Exception:
+                if self._closed.is_set():
+                    return
+                time.sleep(0.1)
+                continue
+            for ev in events:
+                if ev.type == SESSION_EXPIRED:
+                    # the session is gone: deliver the expiry exactly once,
+                    # then terminate — further polling would spin forever on
+                    # the instant "no such session" response
+                    self._closed.set()
+                    with self._wlock:
+                        targets = list(self._session_watchers)
+                    for w in targets:
+                        self._safe_call(w, ev)
+                    return
+                kind = ("children" if ev.type == CHILDREN_CHANGED
+                        else "exists")
+                with self._wlock:
+                    targets = self._watchers.pop((ev.path, kind), [])
+                for w in targets:
+                    self._safe_call(w, ev)
+
+    @staticmethod
+    def _safe_call(w: Watcher, ev: Event) -> None:
+        try:
+            w(ev)
+        except Exception as e:  # a watcher must never kill the dispatcher
+            log.warning("watcher raised", event=ev.type, path=ev.path,
+                        err=repr(e))
+
+    # public API ----------------------------------------------------------
+    def create(self, path: str, data: bytes = b"",
+               mode: str = PERSISTENT) -> str:
+        raise NotImplementedError
+
+    def ensure(self, path: str, data: bytes = b"") -> None:
+        """Create-if-absent for persistent namespace nodes
+        (``LeaderElection.initializeElectionNode``,
+        ``ServiceRegistry.createServiceRegistryZnode``)."""
+        try:
+            self.create(path, data, PERSISTENT)
+        except NodeExistsError:
+            pass
+
+    def close(self) -> None:
+        self._closed.set()
+
+
+class LocalCoordination(_BaseCoordination):
+    """A session on an in-process :class:`CoordinationCore`.
+
+    Used by tests (the embedded fake the reference never had, SURVEY.md §4)
+    and by single-process multi-node runs where all nodes share one core.
+    """
+
+    def __init__(self, core: CoordinationCore,
+                 heartbeat_interval_s: float | None = None) -> None:
+        super().__init__()
+        self.core = core
+        self.sid = core.new_session()
+        interval = (heartbeat_interval_s if heartbeat_interval_s is not None
+                    else core.session_timeout_s / 4)
+        self._hb = threading.Thread(target=self._hb_loop, args=(interval,),
+                                    daemon=True, name="coord-heartbeat")
+        self._hb.start()
+        self.start()
+
+    def _hb_loop(self, interval: float) -> None:
+        while not self._closed.is_set():
+            time.sleep(interval)
+            try:
+                if not self.core.heartbeat(self.sid):
+                    return
+            except Exception:
+                pass
+
+    def _poll(self, timeout_s: float) -> list[Event]:
+        return self.core.poll_events(self.sid, timeout_s)
+
+    def create(self, path, data=b"", mode=PERSISTENT):
+        return self.core.create(self.sid, path, data, mode)
+
+    def delete(self, path):
+        self.core.delete(self.sid, path)
+
+    def exists(self, path, watcher: Watcher | None = None) -> bool:
+        self._arm(path, "exists", watcher)
+        return self.core.exists(self.sid, path, watch=watcher is not None)
+
+    def get_data(self, path) -> bytes:
+        return self.core.get_data(self.sid, path)
+
+    def set_data(self, path, data: bytes) -> None:
+        self.core.set_data(self.sid, path, data)
+
+    def get_children(self, path, watcher: Watcher | None = None) -> list[str]:
+        self._arm(path, "children", watcher)
+        return self.core.get_children(self.sid, path,
+                                      watch=watcher is not None)
+
+    def close(self) -> None:
+        super().close()
+        try:
+            self.core.close_session(self.sid)
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# HTTP transport
+# --------------------------------------------------------------------------
+
+class _CoordHandler(BaseHTTPRequestHandler):
+    core: CoordinationCore  # set by server factory
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route to structured logger
+        pass
+
+    def _reply(self, obj: dict, code: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        u = urlparse(self.path)
+        if u.path == "/events":
+            q = parse_qs(u.query)
+            sid = int(q["session"][0])
+            timeout = float(q.get("timeout", ["25"])[0])
+            evs = self.core.poll_events(sid, timeout)
+            self._reply({"events": [[e.type, e.path] for e in evs]})
+        else:
+            self._reply({"error": "not found"}, 404)
+
+    def do_POST(self) -> None:
+        n = int(self.headers.get("Content-Length", "0"))
+        req = json.loads(self.rfile.read(n) or b"{}")
+        op = req.get("op")
+        sid = req.get("session", 0)
+        try:
+            if op == "new_session":
+                self._reply({"session": self.core.new_session(),
+                             "timeout_s": self.core.session_timeout_s})
+            elif op == "heartbeat":
+                self._reply({"ok": self.core.heartbeat(sid)})
+            elif op == "close_session":
+                self.core.close_session(sid)
+                self._reply({"ok": True})
+            elif op == "create":
+                full = self.core.create(sid, req["path"],
+                                        bytes.fromhex(req.get("data", "")),
+                                        req.get("mode", PERSISTENT))
+                self._reply({"path": full})
+            elif op == "delete":
+                self.core.delete(sid, req["path"])
+                self._reply({"ok": True})
+            elif op == "exists":
+                self._reply({"exists": self.core.exists(
+                    sid, req["path"], watch=req.get("watch", False))})
+            elif op == "get_data":
+                self._reply(
+                    {"data": self.core.get_data(sid, req["path"]).hex()})
+            elif op == "set_data":
+                self.core.set_data(sid, req["path"],
+                                   bytes.fromhex(req.get("data", "")))
+                self._reply({"ok": True})
+            elif op == "get_children":
+                self._reply({"children": self.core.get_children(
+                    sid, req["path"], watch=req.get("watch", False))})
+            else:
+                self._reply({"error": f"bad op {op!r}"}, 400)
+        except NodeExistsError as e:
+            self._reply({"error": "node_exists", "path": str(e)}, 409)
+        except NoNodeError as e:
+            self._reply({"error": "no_node", "path": str(e)}, 404)
+
+
+class CoordinationServer:
+    """Serve a :class:`CoordinationCore` over HTTP (the ZooKeeper-server
+    role at ``zookeeper.connection``, ``application.properties:2``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 session_timeout_s: float = 3.0) -> None:
+        self.core = CoordinationCore(session_timeout_s)
+        handler = type("Handler", (_CoordHandler,), {"core": self.core})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.address = f"{host}:{self.httpd.server_address[1]}"
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="coord-server")
+
+    def start(self) -> "CoordinationServer":
+        self._thread.start()
+        log.info("coordination server up", address=self.address)
+        return self
+
+    def close(self) -> None:
+        self.core.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class CoordinationClient(_BaseCoordination):
+    """HTTP client session — the ``ZooKeeper`` client-bean analog
+    (``config/ZookeeperConfig.java:15-21``)."""
+
+    def __init__(self, address: str,
+                 heartbeat_interval_s: float | None = None,
+                 timeout_s: float = 5.0) -> None:
+        super().__init__()
+        self.base = f"http://{address}"
+        self.timeout_s = timeout_s
+        r = self._rpc({"op": "new_session"})
+        self.sid = r["session"]
+        interval = (heartbeat_interval_s if heartbeat_interval_s is not None
+                    else float(r["timeout_s"]) / 4)
+        self._hb = threading.Thread(target=self._hb_loop, args=(interval,),
+                                    daemon=True, name="coord-heartbeat")
+        self._hb.start()
+        self.start()
+
+    def _rpc(self, req: dict) -> dict:
+        req.setdefault("session", getattr(self, "sid", 0))
+        body = json.dumps(req).encode()
+        r = urllib.request.Request(self.base + "/rpc", data=body,
+                                   headers={"Content-Type":
+                                            "application/json"})
+        try:
+            with urllib.request.urlopen(r, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            payload = json.loads(e.read() or b"{}")
+            if payload.get("error") == "node_exists":
+                raise NodeExistsError(payload.get("path", ""))
+            if payload.get("error") == "no_node":
+                raise NoNodeError(payload.get("path", ""))
+            raise
+
+    def _hb_loop(self, interval: float) -> None:
+        while not self._closed.is_set():
+            time.sleep(interval)
+            try:
+                if not self._rpc({"op": "heartbeat"}).get("ok"):
+                    return
+            except Exception:
+                pass  # transient server unavailability: keep trying
+
+    def _poll(self, timeout_s: float) -> list[Event]:
+        url = (f"{self.base}/events?session={self.sid}"
+               f"&timeout={timeout_s}")
+        with urllib.request.urlopen(url, timeout=timeout_s + 5) as resp:
+            payload = json.loads(resp.read())
+        return [Event(t, p) for t, p in payload["events"]]
+
+    def create(self, path, data=b"", mode=PERSISTENT):
+        return self._rpc({"op": "create", "path": path, "data": data.hex(),
+                          "mode": mode})["path"]
+
+    def delete(self, path):
+        self._rpc({"op": "delete", "path": path})
+
+    def exists(self, path, watcher: Watcher | None = None) -> bool:
+        self._arm(path, "exists", watcher)
+        return self._rpc({"op": "exists", "path": path,
+                          "watch": watcher is not None})["exists"]
+
+    def get_data(self, path) -> bytes:
+        return bytes.fromhex(self._rpc({"op": "get_data",
+                                        "path": path})["data"])
+
+    def set_data(self, path, data: bytes) -> None:
+        self._rpc({"op": "set_data", "path": path, "data": data.hex()})
+
+    def get_children(self, path, watcher: Watcher | None = None) -> list[str]:
+        self._arm(path, "children", watcher)
+        return self._rpc({"op": "get_children", "path": path,
+                          "watch": watcher is not None})["children"]
+
+    def close(self) -> None:
+        super().close()
+        try:
+            self._rpc({"op": "close_session"})
+        except Exception:
+            pass

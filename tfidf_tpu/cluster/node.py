@@ -1,0 +1,406 @@
+"""SearchNode — the symmetric node binary (L2 + L3 + ops API).
+
+Every node runs the same code (like the reference's single Spring Boot
+binary); the role is decided at runtime by leader election. The HTTP surface
+is API-compatible with the reference so a reference client can switch
+unmodified:
+
+Worker data plane (``worker/Worker.java``):
+    POST /worker/process      — score a query against the local shard (:175)
+    POST /worker/upload       — save + index one document (:125)
+    GET  /worker/download     — stream a document, traversal-safe (:97)
+    GET  /worker/index-size   — load metric in bytes (:147)
+
+Leader control plane (``leader/Leader.java``):
+    POST /leader/start        — scatter-gather search, sum-merge (:39-92)
+    POST /leader/upload       — least-loaded placement (:153-207)
+    GET  /leader/download     — local disk, else probe workers (:95-151)
+
+Ops (``controller/Controllers.java``):
+    GET  /api/status          — am-I-leader (:25-29)
+    GET  /api/services        — live membership (:30-37)
+    GET  /api/metrics         — framework addition: counters + timings
+
+Intentional departures from the reference (flagged per SURVEY.md §3.2):
+the scatter fan-out is parallel (the reference loops serially,
+``Leader.java:51-70``); result ordering defaults to score-descending with
+``result_order="name"`` reproducing the reference's alphabetical TreeMap
+(``Leader.java:80-91``).
+"""
+
+from __future__ import annotations
+
+import email.parser
+import email.policy
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tfidf_tpu.cluster.election import LeaderElection
+from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.faults import global_injector
+from tfidf_tpu.utils.logging import get_logger
+from tfidf_tpu.utils.metrics import global_metrics
+
+log = get_logger("cluster.node")
+
+
+# ---- tiny HTTP client helpers (RestTemplate analog, Leader.java:42) ----
+
+def http_get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def http_post(url: str, data: bytes, content_type: str = "application/json",
+              timeout: float = 30.0, headers: dict | None = None) -> bytes:
+    h = {"Content-Type": content_type}
+    h.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=h)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _parse_multipart(body: bytes, content_type: str
+                     ) -> tuple[str | None, bytes]:
+    """Extract (filename, payload) from a multipart/form-data body — the
+    reference accepts Spring ``MultipartFile`` uploads (``Leader.java:153``,
+    ``Worker.java:125``); this keeps ``curl -F file=@doc.txt`` working."""
+    msg = email.parser.BytesParser(policy=email.policy.default).parsebytes(
+        b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body)
+    for part in msg.iter_parts():
+        fn = part.get_filename()
+        if fn is not None:
+            return fn, part.get_payload(decode=True) or b""
+    return None, b""
+
+
+class SearchNode:
+    """One node: engine + election + registry + HTTP server."""
+
+    def __init__(self, config: Config | None = None, coord=None,
+                 engine: Engine | None = None, coord_factory=None) -> None:
+        """``coord_factory`` (no-arg callable returning a fresh coordination
+        client) enables rejoin after a session expiry — the capability the
+        reference lacks (its ``Application.process`` only logs and
+        ``notifyAll``s on disconnect, ``app/Application.java:49-66``; an
+        expired node stays out of the cluster until the pod restarts)."""
+        self.config = config or Config()
+        if coord is None and coord_factory is not None:
+            coord = coord_factory()
+        assert coord is not None, "a coordination client is required"
+        self.coord = coord
+        self._coord_factory = coord_factory
+        self._stopping = False
+        self.engine = engine or Engine(self.config)
+        self.registry = ServiceRegistry(coord)
+        self.election = LeaderElection(coord, callback=self)
+        coord.on_session_event(self._on_session_event)
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="fanout")
+
+        handler = type("Handler", (_NodeHandler,), {"node": self})
+        self.httpd = ThreadingHTTPServer(
+            (self.config.host, self.config.port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        # the reference builds this from POD_IP + SERVER_PORT env vars
+        # (OnElectionAction.java:35-36)
+        self.url = f"http://{self.config.host}:{self.port}"
+        self._server_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name=f"node-{self.port}")
+
+    # ---- lifecycle (app/Application.java:33-46) ----
+
+    def start(self, rebuild: bool = True) -> "SearchNode":
+        self._server_thread.start()
+        if rebuild:   # boot-time re-walk (Worker.java:77-88)
+            self.engine.build_from_directory()
+        self.election.volunteer_for_leadership()
+        self.election.reelect_leader()
+        log.info("node started", url=self.url,
+                 leader=self.election.is_leader())
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        self.election.resign()
+        self.registry.unregister_from_cluster()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._pool.shutdown(wait=False)
+
+    # ---- session-expiry recovery ----
+
+    def _on_session_event(self, ev) -> None:
+        log.warning("coordination session expired", url=self.url)
+        if self._stopping or self._coord_factory is None:
+            return
+        threading.Thread(target=self._rejoin, daemon=True,
+                         name=f"rejoin-{self.port}").start()
+
+    def _rejoin(self) -> None:
+        """Reconnect with a fresh session and re-enter election + registry.
+        All prior ephemerals are gone with the old session, so this is a
+        clean re-volunteer (the role may change: an ex-leader can come back
+        as a worker)."""
+        delay = 0.2
+        while not self._stopping:
+            try:
+                coord = self._coord_factory()
+                self.coord = coord
+                self.registry = ServiceRegistry(coord)
+                self.election = LeaderElection(coord, callback=self)
+                coord.on_session_event(self._on_session_event)
+                self.election.volunteer_for_leadership()
+                self.election.reelect_leader()
+                global_metrics.inc("session_rejoins")
+                log.info("rejoined cluster after session expiry",
+                         url=self.url, leader=self.election.is_leader())
+                return
+            except Exception as e:
+                log.warning("rejoin attempt failed", err=repr(e))
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
+
+    # ---- role transitions (leader/OnElectionAction.java:27-77) ----
+
+    def on_elected_to_be_leader(self) -> None:
+        # the leader does not serve a shard: leave the worker pool (:30)
+        self.registry.unregister_from_cluster()
+        self.registry.register_for_updates()
+        publish_leader_info(self.coord, self.url)
+        global_metrics.inc("elections_won")
+        log.info("assumed leader role", url=self.url)
+
+    def on_worker(self) -> None:
+        self.registry.register_to_cluster(self.url)
+        log.info("assumed worker role", url=self.url)
+
+    def is_leader(self) -> bool:
+        return self.election.is_leader()
+
+    # ---- leader logic (leader/Leader.java) ----
+
+    def leader_search(self, query: str) -> dict[str, float]:
+        """Scatter-gather search (``Leader.java:39-92``): fan the query out
+        to every registered worker, tolerate per-worker failure, sum-merge
+        scores by document name."""
+        workers = self.registry.get_all_service_addresses()
+        log.info("scatter search", query=query, workers=len(workers))
+
+        def one(addr: str) -> list:
+            global_injector.check("leader.worker_rpc")
+            body = json.dumps({"query": query}).encode()
+            return json.loads(http_post(addr + "/worker/process", body,
+                                        timeout=10.0))
+
+        merged: dict[str, float] = {}
+        futures = {self._pool.submit(one, w): w for w in workers}
+        for fut, addr in futures.items():
+            try:
+                hits = fut.result()
+            except Exception as e:
+                # per-worker tolerance (Leader.java:67-69)
+                global_metrics.inc("scatter_failures")
+                log.warning("worker failed during search", worker=addr,
+                            err=repr(e))
+                continue
+            for hit in hits:
+                name = hit["document"]["name"]
+                merged[name] = merged.get(name, 0.0) + float(hit["score"])
+        if self.config.result_order == "name":
+            # alphabetical, the reference's TreeMap order (Leader.java:80-91)
+            return dict(sorted(merged.items()))
+        return dict(sorted(merged.items(), key=lambda kv: (-kv[1], kv[0])))
+
+    def leader_upload(self, filename: str, data: bytes) -> dict:
+        """Least-loaded placement (``Leader.java:153-207``): poll every
+        worker's index size, forward the file to the smallest."""
+        workers = self.registry.get_all_service_addresses()
+        if not workers:
+            raise RuntimeError("no workers registered")
+        sizes: dict[str, int] = {}
+        for w in workers:   # serial polling, like Leader.java:170-179
+            try:
+                global_injector.check("leader.size_poll")
+                sizes[w] = int(http_get(w + "/worker/index-size"))
+            except Exception as e:
+                log.warning("index-size poll failed", worker=w, err=repr(e))
+        if not sizes:
+            raise RuntimeError("no reachable workers")
+        chosen = min(sizes, key=lambda w: (sizes[w], w))
+        q = urllib.parse.quote(filename)
+        http_post(chosen + f"/worker/upload?name={q}", data,
+                  content_type="application/octet-stream")
+        global_metrics.inc("uploads_placed")
+        log.info("upload placed", file=filename, worker=chosen,
+                 size=sizes[chosen])
+        return {"worker": chosen, "sizes": sizes}
+
+    def leader_download(self, rel: str) -> bytes | None:
+        """Serve from local disk, else probe every worker and proxy the
+        first hit (``Leader.java:95-151``)."""
+        data = self.engine.open_document(rel)
+        if data is not None:
+            return data
+        q = urllib.parse.quote(rel)
+        for w in self.registry.get_all_service_addresses():
+            try:
+                return http_get(w + f"/worker/download?path={q}")
+            except Exception:
+                continue   # first 2xx wins; probe the next (Leader.java:144)
+        return None
+
+
+class _NodeHandler(BaseHTTPRequestHandler):
+    node: SearchNode   # bound by SearchNode.__init__
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    # ---- plumbing ----
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200) -> None:
+        self._send(code, json.dumps(obj).encode())
+
+    def _text(self, s: str, code: int = 200) -> None:
+        self._send(code, s.encode(), "text/plain; charset=utf-8")
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(n) if n else b""
+
+    def _query_param(self, u, name: str) -> str | None:
+        vals = urllib.parse.parse_qs(u.query).get(name)
+        return vals[0] if vals else None
+
+    def _read_upload(self, u) -> tuple[str | None, bytes]:
+        body = self._body()
+        ctype = self.headers.get("Content-Type", "")
+        if ctype.startswith("multipart/form-data"):
+            return _parse_multipart(body, ctype)
+        return self._query_param(u, "name"), body
+
+    def _read_query(self) -> str:
+        """The search query: accept raw text (the reference POSTs the bare
+        query string, ``Leader.java:54-59``) or ``{"query": ...}`` JSON."""
+        body = self._body().decode("utf-8", "replace")
+        try:
+            obj = json.loads(body)
+            if isinstance(obj, dict) and "query" in obj:
+                return str(obj["query"])
+            if isinstance(obj, str):
+                return obj
+        except json.JSONDecodeError:
+            pass
+        return body
+
+    # ---- routing ----
+
+    def do_GET(self) -> None:
+        u = urllib.parse.urlparse(self.path)
+        node = self.node
+        try:
+            if u.path == "/worker/index-size":
+                self._text(str(node.engine.index_size_bytes()))
+            elif u.path == "/worker/download":
+                self._download_from_engine(u)
+            elif u.path == "/leader/download":
+                rel = urllib.parse.unquote(self._query_param(u, "path") or "")
+                try:
+                    data = node.leader_download(rel)
+                except PermissionError:
+                    self._text("invalid path", 400)
+                    return
+                if data is None:
+                    self._text("not found", 404)
+                else:
+                    self._send(200, data, "application/octet-stream")
+            elif u.path == "/api/status":
+                # same phrasing as Controllers.java:25-29
+                self._text("I am the leader" if node.is_leader()
+                           else "I am a worker node")
+            elif u.path == "/api/services":
+                self._json(node.registry.get_all_service_addresses())
+            elif u.path == "/api/metrics":
+                self._json(global_metrics.snapshot())
+            else:
+                self._text("not found", 404)
+        except Exception as e:
+            log.warning("request failed", path=u.path, err=repr(e))
+            self._text(f"error: {e!r}", 500)
+
+    def do_POST(self) -> None:
+        u = urllib.parse.urlparse(self.path)
+        node = self.node
+        try:
+            if u.path == "/worker/process":
+                global_injector.check("worker.process")
+                query = self._read_query()
+                try:
+                    hits = node.engine.search(query, unbounded=True)
+                except Exception as e:
+                    # reference returns [] on any failure (Worker.java:183)
+                    log.warning("search failed", err=repr(e))
+                    hits = []
+                global_metrics.inc("queries_served")
+                self._json([{"document": {"name": h.name}, "score": h.score}
+                            for h in hits])
+            elif u.path == "/worker/upload":
+                name, data = self._read_upload(u)
+                if not name:
+                    self._text("missing file name", 400)
+                    return
+                global_injector.check("worker.upload")
+                node.engine.ingest_bytes(name, data, save_to_disk=True)
+                node.engine.commit()
+                global_metrics.inc("docs_indexed")
+                self._text(f"File {name} uploaded and indexed")
+            elif u.path == "/leader/start":
+                query = self._read_query()
+                self._json(node.leader_search(query))
+            elif u.path == "/leader/upload":
+                name, data = self._read_upload(u)
+                if not name:
+                    self._text("missing file name", 400)
+                    return
+                result = node.leader_upload(name, data)
+                self._text(f"File uploaded successfully to worker: "
+                           f"{result['worker']}")
+            else:
+                self._text("not found", 404)
+        except Exception as e:
+            log.warning("request failed", path=u.path, err=repr(e))
+            self._text(f"error: {e!r}", 500)
+
+    def _download_from_engine(self, u) -> None:
+        # URL-decode + traversal check live in Engine._safe_doc_path
+        # (Worker.java:97-121 parity)
+        rel = urllib.parse.unquote(self._query_param(u, "path") or "")
+        try:
+            data = self.node.engine.open_document(rel)
+        except PermissionError:
+            self._text("invalid path", 400)
+            return
+        if data is None:
+            self._text("not found", 404)
+        else:
+            self._send(200, data, "application/octet-stream")

@@ -1,0 +1,133 @@
+"""Service registry — discovery via the coordination substrate.
+
+Re-implements ``registry/ServiceRegistry.java:16-123``: workers register an
+ephemeral-sequential znode under ``/service_registry`` whose data payload is
+the worker's base URL (``:54-64``); any node can subscribe to membership
+changes — the address cache is refreshed and the one-shot watch re-armed on
+every change (``:91-122``); the leader unregisters itself so it never serves
+a shard (``:76-86``, ``OnElectionAction.java:30``).
+
+The elected leader additionally publishes its own address at the ephemeral
+``/leader_info`` node (``OnElectionAction.java:45-54``) so external clients
+can find the coordinator.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tfidf_tpu.cluster.coordination import (EPHEMERAL, EPHEMERAL_SEQUENTIAL,
+                                            Event, NodeExistsError,
+                                            NoNodeError)
+from tfidf_tpu.utils.logging import get_logger
+
+log = get_logger("cluster.registry")
+
+REGISTRY_NAMESPACE = "/service_registry"
+WORKER_PREFIX = "n_"
+LEADER_INFO = "/leader_info"
+
+
+class ServiceRegistry:
+    def __init__(self, coord) -> None:
+        self.coord = coord
+        self._znode: str | None = None
+        self._addresses: tuple[str, ...] | None = None
+        self._lock = threading.Lock()
+        self.coord.ensure(REGISTRY_NAMESPACE)   # (:35-51)
+
+    # ``registerToCluster`` (:54-64)
+    def register_to_cluster(self, address: str) -> None:
+        if self._znode is not None and self.coord.exists(self._znode):
+            return   # already registered (same guard as :56-59)
+        self._znode = self.coord.create(
+            f"{REGISTRY_NAMESPACE}/{WORKER_PREFIX}", address.encode(),
+            mode=EPHEMERAL_SEQUENTIAL)
+        log.info("registered to cluster", znode=self._znode, address=address)
+
+    # ``registerForUpdates`` (:66-74)
+    def register_for_updates(self) -> None:
+        self._update_addresses()
+
+    # ``unregisterFromCluster`` (:76-86)
+    def unregister_from_cluster(self) -> None:
+        if self._znode is not None:
+            try:
+                self.coord.delete(self._znode)
+            except NoNodeError:
+                pass
+            log.info("unregistered from cluster", znode=self._znode)
+            self._znode = None
+
+    # ``getAllServiceAddresses`` (:87-89): cached, lazily initialized
+    def get_all_service_addresses(self) -> list[str]:
+        with self._lock:
+            cached = self._addresses
+        if cached is None:
+            self._update_addresses()
+            with self._lock:
+                cached = self._addresses or ()
+        return list(cached)
+
+    # ``updateAddresses`` (:91-111): re-read children + data, swap cache,
+    # re-arm the one-shot watch by passing the watcher again.
+    def _update_addresses(self) -> None:
+        with self._lock:
+            names = self.coord.get_children(REGISTRY_NAMESPACE,
+                                            watcher=self._on_change)
+            addrs = []
+            for name in names:
+                try:
+                    data = self.coord.get_data(
+                        f"{REGISTRY_NAMESPACE}/{name}")
+                except NoNodeError:
+                    continue   # vanished between listing and read (:99-103)
+                addrs.append(data.decode())
+            self._addresses = tuple(addrs)
+            log.info("cluster addresses updated", addresses=addrs)
+
+    # ``process(WatchedEvent)`` (:113-122). The one-shot watch was consumed
+    # when this fired, so a failed refresh MUST be retried — otherwise the
+    # membership cache freezes forever on a transient coordination hiccup.
+    def _on_change(self, ev: Event) -> None:
+        for delay in (0.0, 0.1, 0.5, 1.0):
+            if delay:
+                time.sleep(delay)
+            try:
+                self._update_addresses()
+                return
+            except Exception as e:
+                log.warning("membership refresh failed, retrying",
+                            err=repr(e))
+        # keep trying off the dispatch thread so other events still flow
+        t = threading.Timer(5.0, self._on_change, args=(ev,))
+        t.daemon = True
+        t.start()
+
+
+def publish_leader_info(coord, address: str) -> None:
+    """Publish the ephemeral ``/leader_info`` znode
+    (``OnElectionAction.java:45-54``).
+
+    Unlike the reference's create-or-setData, a leftover node from the
+    previous leader is deleted and re-created so the znode is owned by the
+    NEW leader's session — setData would leave it tied to the old session,
+    and the address would vanish when that session finally expires."""
+    while True:
+        try:
+            coord.create(LEADER_INFO, address.encode(), mode=EPHEMERAL)
+            break
+        except NodeExistsError:
+            try:
+                coord.delete(LEADER_INFO)
+            except NoNodeError:
+                pass
+    log.info("published leader info", address=address)
+
+
+def read_leader_info(coord) -> str | None:
+    try:
+        return coord.get_data(LEADER_INFO).decode()
+    except NoNodeError:
+        return None

@@ -146,9 +146,11 @@ class ShardIndex:
         return sum(d.term_ids.shape[0] for d in self._docs if d.live)
 
     def size_bytes(self) -> int:
-        """Load metric for least-loaded placement (index-size analog)."""
-        if self.snapshot is not None and self._committed_gen == self._gen:
-            return self.snapshot.size_bytes()
+        """Load metric for least-loaded placement (index-size analog,
+        ``Worker.java:147-172``). Measures live postings content — NOT the
+        capacity-bucketed device arrays, whose padded size is identical
+        across lightly-loaded shards and would turn the balancer's min into
+        a constant tie (every upload landing on one worker)."""
         return int(sum(d.term_ids.nbytes + d.tfs.nbytes
                        for d in self._docs if d.live))
 

@@ -304,6 +304,14 @@ def build_ingest_batch(mesh: Mesh,
             local = n_live_before[d] + i
             new_len[d, i] = lens[i]
             for t, f in sorted(counts.items()):
+                if not 0 <= t < arrays.vocab_cap:
+                    # the sharded path has no vocab growth; an out-of-range
+                    # id would be clamped by XLA's gather at search time and
+                    # silently score against another term's df
+                    raise ValueError(
+                        f"term id {t} outside vocab capacity "
+                        f"{arrays.vocab_cap}; grow the vocabulary and "
+                        "rebuild the sharded arrays first")
                 terms.append(t)
                 tfs.append(float(f))
                 rows.append(local)

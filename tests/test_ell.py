@@ -1,0 +1,222 @@
+"""Blocked-ELL layout tests: build correctness, scoring parity with COO.
+
+The ELL path must be a pure re-layout: identical scores to the chunked COO
+scatter path for every model, including documents that spill into the
+residual. Engine-level tests confirm the default layout produces the same
+search results as layout="coo".
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.oracle import random_corpus as oracle_random_corpus
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.ops.csr import build_coo
+from tfidf_tpu.ops.ell import (build_ell_from_coo, ell_impacts,
+                               score_ell_batch)
+from tfidf_tpu.ops.scoring import make_query_batch, score_coo_batch
+from tfidf_tpu.utils.config import Config
+
+
+def random_corpus(rng, n_docs=40, vocab=64, max_len=30):
+    """Oracle corpus, re-sorted by distinct-term count DESC (the to_coo
+    order the blocked layout requires)."""
+    docs, lengths = oracle_random_corpus(rng, n_docs=n_docs, vocab=vocab,
+                                         max_len=max_len)
+    order = np.argsort([-len(d) for d in docs], kind="stable")
+    return [docs[i] for i in order], [lengths[i] for i in order]
+
+
+def random_queries(rng, vocab, B=4, T=6):
+    q_terms = rng.integers(0, vocab, size=(B, T)).astype(np.int32)
+    q_weights = rng.random((B, T)).astype(np.float32)
+    return make_query_batch(q_terms, q_weights, min_slots=8)
+
+
+def build_ell_arrays(coo, model, n_docs, avgdl, *, width_cap,
+                     min_rows=8, doc_norms=None):
+    """Mirror ShardIndex.commit's ELL assembly for direct op tests."""
+    ell = build_ell_from_coo(coo, width_cap=width_cap, min_rows=min_rows)
+    impacts, terms, live = [], [], []
+    for blk in ell.blocks:
+        rows_cap = blk.tf.shape[0]
+        dl = np.zeros(rows_cap, np.float32)
+        dl[:blk.n_rows] = coo.doc_len[blk.row0:blk.row0 + blk.n_rows]
+        nrm = np.zeros(rows_cap, np.float32)
+        if doc_norms is not None:
+            nrm[:blk.n_rows] = doc_norms[blk.row0:blk.row0 + blk.n_rows]
+        impacts.append(ell_impacts(
+            jnp.asarray(blk.tf), jnp.asarray(blk.term), jnp.asarray(dl),
+            jnp.asarray(coo.df), n_docs, avgdl, jnp.asarray(nrm),
+            model=model))
+        terms.append(jnp.asarray(blk.term))
+        live.append(blk.n_rows)
+    return ell, tuple(impacts), tuple(terms), jnp.asarray(
+        np.asarray(live, np.int32))
+
+
+class TestBuild:
+    def test_roundtrip_no_spill(self, rng):
+        docs, _ = random_corpus(rng)
+        coo = build_coo(docs, vocab_cap=128, min_nnz_cap=1 << 10,
+                        min_doc_cap=64)
+        ell = build_ell_from_coo(coo, width_cap=64, min_rows=8)
+        assert ell.res_nnz == 0
+        # every doc's counts appear at its (blocked) row
+        for d, counts in enumerate(docs):
+            blk = next(b for b in ell.blocks
+                       if b.row0 <= d < b.row0 + b.n_rows)
+            r = d - blk.row0
+            row = {int(t): float(f)
+                   for t, f in zip(blk.term[r], blk.tf[r]) if f > 0}
+            assert row == {t: float(f) for t, f in counts.items()}
+
+    def test_blocks_bucketed_by_width(self, rng):
+        docs, _ = random_corpus(rng, n_docs=60, vocab=128, max_len=100)
+        coo = build_coo(docs, vocab_cap=256, min_nnz_cap=1 << 12,
+                        min_doc_cap=64)
+        ell = build_ell_from_coo(coo, width_cap=256, min_rows=8)
+        widths = [b.width for b in ell.blocks]
+        assert widths == sorted(widths, reverse=True)   # non-increasing
+        assert len(set(widths)) == len(widths)          # distinct buckets
+        # blocks tile the doc rows contiguously
+        covered = 0
+        for b in ell.blocks:
+            assert b.row0 == covered
+            covered += b.n_rows
+        assert covered == len(docs)
+        # padding stays bounded: blocked entries < 2x the true nnz + bucket
+        padded = sum(b.tf.shape[0] * b.width for b in ell.blocks)
+        assert padded < 2 * coo.nnz + 8 * 256
+
+    def test_spill_to_residual(self, rng):
+        docs, _ = random_corpus(rng, n_docs=10, vocab=200, max_len=150)
+        coo = build_coo(docs, vocab_cap=256, min_nnz_cap=1 << 11,
+                        min_doc_cap=16)
+        ell = build_ell_from_coo(coo, width_cap=16, min_rows=8)
+        total = sum(len(d) for d in docs)
+        main = sum(int((b.tf > 0).sum()) for b in ell.blocks)
+        assert main + ell.res_nnz == total
+        assert ell.res_nnz > 0
+        assert (np.diff(ell.res_doc) >= 0).all()
+
+    def test_unsorted_rows_rejected(self, rng):
+        docs = [{1: 1}, {1: 1, 2: 1, 3: 1}]    # ascending length
+        coo = build_coo(docs, vocab_cap=8, min_nnz_cap=64, min_doc_cap=8)
+        with pytest.raises(AssertionError):
+            build_ell_from_coo(coo, width_cap=8)
+
+    def test_empty_corpus(self):
+        coo = build_coo([], vocab_cap=32, min_nnz_cap=64, min_doc_cap=8)
+        ell = build_ell_from_coo(coo, width_cap=32)
+        assert ell.blocks == [] and ell.res_nnz == 0
+
+
+class TestScoringParity:
+    @pytest.mark.parametrize("model", ["bm25", "tfidf"])
+    @pytest.mark.parametrize("width_cap", [8, 64])
+    def test_ell_matches_coo(self, rng, model, width_cap):
+        """Blocked ELL + residual scores == COO scatter scores."""
+        docs, lengths = random_corpus(rng)
+        coo = build_coo(docs, vocab_cap=128, min_nnz_cap=1 << 10,
+                        min_doc_cap=64)
+        qb = random_queries(rng, vocab=64)
+        n_docs = jnp.float32(len(docs))
+        avgdl = jnp.float32(np.mean(lengths))
+
+        ref = score_coo_batch(
+            jnp.asarray(coo.tf), jnp.asarray(coo.term), jnp.asarray(coo.doc),
+            jnp.asarray(coo.doc_len), jnp.asarray(coo.df),
+            qb, n_docs, avgdl, model=model, chunk=256)
+
+        ell, impacts, terms, live = build_ell_arrays(
+            coo, model, n_docs, avgdl, width_cap=width_cap)
+        got = score_ell_batch(
+            impacts, terms, live,
+            jnp.asarray(ell.res_tf), jnp.asarray(ell.res_term),
+            jnp.asarray(ell.res_doc),
+            jnp.asarray(coo.doc_len), jnp.asarray(coo.df),
+            qb, n_docs, avgdl, model=model)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_doc_chunking_invariant(self, rng):
+        """Scores identical for any doc_chunk."""
+        docs, lengths = random_corpus(rng)
+        coo = build_coo(docs, vocab_cap=128, min_nnz_cap=1 << 10,
+                        min_doc_cap=64)
+        qb = random_queries(rng, vocab=64)
+        n_docs, avgdl = jnp.float32(len(docs)), jnp.float32(np.mean(lengths))
+        ell, impacts, terms, live = build_ell_arrays(
+            coo, "bm25", n_docs, avgdl, width_cap=64)
+        ref = None
+        for chunk in (8, 16, 64):
+            s = score_ell_batch(
+                impacts, terms, live,
+                jnp.asarray(ell.res_tf), jnp.asarray(ell.res_term),
+                jnp.asarray(ell.res_doc),
+                jnp.asarray(coo.doc_len), jnp.asarray(coo.df),
+                qb, n_docs, avgdl, model="bm25", doc_chunk=chunk)
+            if ref is None:
+                ref = np.asarray(s)
+            else:
+                np.testing.assert_allclose(np.asarray(s), ref, rtol=1e-6)
+
+
+class TestEngineLayouts:
+    def test_engine_ell_equals_coo_results(self, tmp_path):
+        texts = {
+            "a.txt": "the quick brown fox jumps over the lazy dog",
+            "b.txt": "a fast brown fox and a quick red fox",
+            "c.txt": "lorem ipsum dolor sit amet " * 30,   # long doc
+            "d.txt": "the dog sleeps all day " * 10,
+        }
+        results = {}
+        for layout in ("ell", "coo"):
+            cfg = Config(documents_path=str(tmp_path / layout),
+                         scoring_layout=layout, ell_width_cap=8,
+                         min_doc_capacity=8, min_nnz_capacity=256,
+                         min_vocab_capacity=64, query_batch=4,
+                         max_query_terms=8)
+            e = Engine(cfg)
+            for name, text in texts.items():
+                e.ingest_text(name, text)
+            e.commit()
+            results[layout] = [
+                e.search(q) for q in ("fox", "dog day", "lorem ipsum")]
+        for hits_e, hits_c in zip(results["ell"], results["coo"]):
+            assert [h.name for h in hits_e] == [h.name for h in hits_c]
+            np.testing.assert_allclose([h.score for h in hits_e],
+                                       [h.score for h in hits_c], rtol=1e-5)
+
+    def test_commit_growth_reuses_executable(self, tmp_path):
+        """Commits that stay within the same capacity buckets must NOT
+        retrace the scoring executable (live counts are traced)."""
+        from tfidf_tpu.ops.ell import score_ell_batch as jitted
+        cfg = Config(documents_path=str(tmp_path), min_doc_capacity=8,
+                     min_nnz_capacity=256, min_vocab_capacity=64,
+                     query_batch=4, max_query_terms=8)
+        e = Engine(cfg)
+        e.ingest_text("a.txt", "alpha beta gamma")
+        e.commit()
+        e.search("alpha")
+        size0 = jitted._cache_size()
+        e.ingest_text("b.txt", "alpha delta epsilon")
+        e.commit()
+        hits = e.search("alpha")
+        assert {h.name for h in hits} == {"a.txt", "b.txt"}
+        assert jitted._cache_size() == size0, "commit retraced the query path"
+
+    def test_ell_snapshot_skips_device_coo(self, tmp_path):
+        cfg = Config(documents_path=str(tmp_path), min_doc_capacity=8,
+                     min_nnz_capacity=256, min_vocab_capacity=64,
+                     query_batch=4, max_query_terms=8)
+        e = Engine(cfg)
+        e.ingest_text("x.txt", "hello world hello")
+        e.commit()
+        snap = e.index.snapshot
+        assert snap.is_ell
+        assert snap.tf is None and snap.term is None and snap.doc is None
+        assert snap.ell_impacts and snap.size_bytes() > 0
+        assert [h.name for h in e.search("hello")] == ["x.txt"]

@@ -4,7 +4,8 @@ import pytest
 
 from tests.oracle import bm25_scores, df_of, random_corpus, tfidf_scores
 from tfidf_tpu.ops.csr import build_coo
-from tfidf_tpu.ops.scoring import cosine_norms, score_coo_batch
+from tfidf_tpu.ops.scoring import (cosine_norms, make_query_batch,
+                                   score_coo_batch)
 from tfidf_tpu.ops.topk import exact_topk, full_ranking, merge_topk
 
 
@@ -20,18 +21,18 @@ def _device_inputs(docs, lengths, vocab_cap, queries, max_terms=8):
             q_weights[i, j] = w
     n = jnp.float32(len(docs))
     avgdl = jnp.float32(sum(lengths) / max(len(lengths), 1))
-    return shard, jnp.asarray(q_terms), jnp.asarray(q_weights), n, avgdl
+    return shard, make_query_batch(q_terms, q_weights, min_slots=8), n, avgdl
 
 
 @pytest.mark.parametrize("model", ["bm25", "tfidf"])
 def test_scoring_matches_oracle(rng, model):
     docs, lengths = random_corpus(rng, n_docs=40, vocab=50)
     queries = [{1: 1.0, 2: 2.0}, {7: 1.0}, {49: 1.0, 0: 1.0, 13: 3.0}]
-    shard, qt, qw, n, avgdl = _device_inputs(docs, lengths, 64, queries)
+    shard, qb, n, avgdl = _device_inputs(docs, lengths, 64, queries)
     scores = score_coo_batch(
         jnp.asarray(shard.tf), jnp.asarray(shard.term),
         jnp.asarray(shard.doc), jnp.asarray(shard.doc_len),
-        jnp.asarray(shard.df), qt, qw, n, avgdl,
+        jnp.asarray(shard.df), qb, n, avgdl,
         model=model, chunk=64)
     scores = np.asarray(scores)
     for i, q in enumerate(queries):
@@ -48,14 +49,14 @@ def test_scoring_matches_oracle(rng, model):
 def test_cosine_model_matches_oracle(rng):
     docs, lengths = random_corpus(rng, n_docs=30, vocab=40)
     queries = [{3: 1.0, 5: 1.0}]
-    shard, qt, qw, n, avgdl = _device_inputs(docs, lengths, 64, queries)
+    shard, qb, n, avgdl = _device_inputs(docs, lengths, 64, queries)
     norms = cosine_norms(jnp.asarray(shard.tf), jnp.asarray(shard.term),
                          jnp.asarray(shard.doc), jnp.asarray(shard.df),
                          n, shard.doc_cap)
     scores = score_coo_batch(
         jnp.asarray(shard.tf), jnp.asarray(shard.term),
         jnp.asarray(shard.doc), jnp.asarray(shard.doc_len),
-        jnp.asarray(shard.df), qt, qw, n, avgdl, norms,
+        jnp.asarray(shard.df), qb, n, avgdl, norms,
         model="tfidf_cosine", chunk=64)
     want = tfidf_scores(docs, queries[0], cosine=True)
     np.testing.assert_allclose(np.asarray(scores)[0, :len(docs)], want,
@@ -70,15 +71,17 @@ def test_duplicate_query_terms_add(rng):
     shard.doc_len[:len(lengths)] = lengths
     n = jnp.float32(len(docs))
     avgdl = jnp.float32(np.mean(lengths))
-    qt1 = jnp.asarray([[5, 5, 0, 0]], jnp.int32)
-    qw1 = jnp.asarray([[1.0, 1.0, 0, 0]], jnp.float32)
-    qt2 = jnp.asarray([[5, 0, 0, 0]], jnp.int32)
-    qw2 = jnp.asarray([[2.0, 0, 0, 0]], jnp.float32)
+    qb1 = make_query_batch(np.asarray([[5, 5, 0, 0]], np.int32),
+                           np.asarray([[1.0, 1.0, 0, 0]], np.float32),
+                           min_slots=4)
+    qb2 = make_query_batch(np.asarray([[5, 0, 0, 0]], np.int32),
+                           np.asarray([[2.0, 0, 0, 0]], np.float32),
+                           min_slots=4)
     args = (jnp.asarray(shard.tf), jnp.asarray(shard.term),
             jnp.asarray(shard.doc), jnp.asarray(shard.doc_len),
             jnp.asarray(shard.df))
-    s1 = score_coo_batch(*args, qt1, qw1, n, avgdl, model="bm25", chunk=64)
-    s2 = score_coo_batch(*args, qt2, qw2, n, avgdl, model="bm25", chunk=64)
+    s1 = score_coo_batch(*args, qb1, n, avgdl, model="bm25", chunk=64)
+    s2 = score_coo_batch(*args, qb2, n, avgdl, model="bm25", chunk=64)
     np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-5)
 
 
@@ -89,12 +92,13 @@ def test_term_zero_is_scorable(rng):
     lengths = [3.0, 1.0, 2.0]
     shard = build_coo(docs, 8, min_nnz_cap=16, min_doc_cap=4)
     shard.doc_len[:3] = lengths
-    qt = jnp.asarray([[0, 0, 0, 0]], jnp.int32)   # query IS term 0 (+ pads)
-    qw = jnp.asarray([[1.0, 0, 0, 0]], jnp.float32)
+    qb = make_query_batch(
+        np.asarray([[0, 0, 0, 0]], np.int32),   # query IS term 0 (+ pads)
+        np.asarray([[1.0, 0, 0, 0]], np.float32), min_slots=4)
     s = score_coo_batch(
         jnp.asarray(shard.tf), jnp.asarray(shard.term),
         jnp.asarray(shard.doc), jnp.asarray(shard.doc_len),
-        jnp.asarray(shard.df), qt, qw,
+        jnp.asarray(shard.df), qb,
         jnp.float32(3), jnp.float32(2.0), model="bm25", chunk=16)
     want = bm25_scores(docs, lengths, {0: 1.0})
     np.testing.assert_allclose(np.asarray(s)[0, :3], want, rtol=1e-4)

@@ -13,6 +13,7 @@ import pytest
 
 from tests.oracle import bm25_scores, df_of, random_corpus
 from tfidf_tpu.ops.csr import build_coo
+from tfidf_tpu.ops.scoring import make_query_batch
 from tfidf_tpu.parallel.mesh import default_mesh_shape, make_mesh
 from tfidf_tpu.parallel.sharded import (build_sharded_arrays, global_stats,
                                         make_sharded_search,
@@ -34,7 +35,7 @@ def _queries(qs, max_terms=8):
         for j, (t, w) in enumerate(sorted(q.items())):
             qt[i, j] = t
             qw[i, j] = w
-    return jnp.asarray(qt), jnp.asarray(qw)
+    return make_query_batch(qt, qw, min_slots=8)
 
 
 def test_mesh_shapes():
@@ -53,9 +54,9 @@ def test_sharded_search_matches_oracle(rng, shape):
     mesh = make_mesh(shape)
     arrays = build_sharded_arrays(shard, mesh, min_chunk_cap=64)
     queries = [{1: 1.0, 2: 2.0}, {7: 1.0}, {0: 1.0, 13: 3.0}]
-    qt, qw = _queries(queries)
+    qb = _queries(queries)
     search = make_sharded_search(mesh, k=10, model="bm25", chunk=64)
-    vals, gids = search(arrays, qt, qw)
+    vals, gids = search(arrays, qb)
     vals, gids = np.asarray(vals), np.asarray(gids)
 
     assign = shard_documents(len(docs), shape[0])
@@ -99,10 +100,10 @@ def test_parity_mode_uses_local_stats(rng):
     mesh = make_mesh((D, 2))
     arrays = build_sharded_arrays(shard, mesh, min_chunk_cap=64)
     q = {1: 1.0, 3: 1.0}
-    qt, qw = _queries([q])
+    qb = _queries([q])
     search = make_sharded_search(mesh, k=24, model="bm25",
                                  global_idf=False, chunk=64)
-    vals, gids = search(arrays, qt, qw)
+    vals, gids = search(arrays, qb)
     vals, gids = np.asarray(vals)[0], np.asarray(gids)[0]
 
     assign = shard_documents(len(docs), D)
@@ -135,9 +136,9 @@ def test_sharded_cosine_model(rng):
     mesh = make_mesh((4, 2))
     arrays = build_sharded_arrays(shard, mesh, min_chunk_cap=64)
     q = {1: 1.0, 3: 2.0}
-    qt, qw = _queries([q])
+    qb = _queries([q])
     search = make_sharded_search(mesh, k=10, model="tfidf_cosine", chunk=64)
-    vals, gids = search(arrays, qt, qw)
+    vals, gids = search(arrays, qb)
     want = np.asarray(tfidf_scores(docs, q, cosine=True))
     top = np.sort(want[want > 0])[::-1][:10]
     got = np.asarray(vals)[0]
@@ -176,9 +177,9 @@ def test_sharded_ingest_then_search(rng):
     all_docs = docs + new_docs
     all_lens = lengths + new_lengths
     q = {1: 1.0, 3: 2.0}
-    qt, qw = _queries([q])
+    qb = _queries([q])
     search = make_sharded_search(mesh, k=15, model="bm25", chunk=64)
-    vals, gids = search(arrays2, qt, qw)
+    vals, gids = search(arrays2, qb)
     want = np.asarray(bm25_scores(all_docs, all_lens, q))
 
     # build global-id map: old docs then new placements

@@ -41,7 +41,9 @@ class Engine:
         self.index = ShardIndex(
             self.model,
             min_nnz_cap=c.min_nnz_capacity,
-            min_doc_cap=c.min_doc_capacity)
+            min_doc_cap=c.min_doc_capacity,
+            layout=c.scoring_layout,
+            ell_width_cap=c.ell_width_cap)
         self.searcher = Searcher(
             self.index, self.analyzer, self.vocab, self.model,
             query_batch=c.query_batch, max_query_terms=c.max_query_terms,

@@ -32,6 +32,8 @@ import numpy as np
 
 from tfidf_tpu.models.base import ScoringModel
 from tfidf_tpu.ops.csr import CooShard, next_capacity
+from tfidf_tpu.ops.ell import (build_ell_from_coo, cosine_norms_host,
+                               ell_impacts)
 from tfidf_tpu.ops.scoring import cosine_norms
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
@@ -50,11 +52,17 @@ class DocEntry:
 
 @dataclass
 class Snapshot:
-    """Immutable device-resident index state — what queries score against."""
+    """Immutable device-resident index state — what queries score against.
 
-    tf: jax.Array          # f32 [nnz_cap]
-    term: jax.Array        # i32 [nnz_cap]
-    doc: jax.Array         # i32 [nnz_cap]
+    Two layouts: COO (``tf``/``term``/``doc`` device arrays, scatter
+    scoring) or blocked ELL (``ell_*`` block tuples + COO residual, gather
+    scoring — the TPU fast path; the COO fields stay None and never ship
+    to device).
+    """
+
+    tf: jax.Array | None   # f32 [nnz_cap] (None in ELL layout)
+    term: jax.Array | None # i32 [nnz_cap]
+    doc: jax.Array | None  # i32 [nnz_cap]
     doc_len: jax.Array     # f32 [doc_cap] (model-transformed, e.g. quantized)
     df: jax.Array          # f32 [vocab_cap]
     doc_norms: jax.Array   # f32 [doc_cap] (zeros unless cosine model)
@@ -65,16 +73,33 @@ class Snapshot:
     version: int = 0
     nnz: int = 0
     host_coo: CooShard | None = None   # host copy for mesh re-sharding
+    # Blocked-ELL fast path (tfidf_tpu.ops.ell): per-commit precomputed
+    # impact blocks + term rows, plus a COO residual for overlong docs.
+    ell_impacts: tuple = ()       # tuple of f32 [rows_cap_i, width_i]
+    ell_terms: tuple = ()         # tuple of i32 [rows_cap_i, width_i]
+    # live rows per block — TRACED so commits within the same capacity
+    # buckets never retrace the query path
+    ell_live: jax.Array | None = None     # i32 [n_blocks]
+    res_tf: jax.Array | None = None       # f32 [res_cap] (None: no spill)
+    res_term: jax.Array | None = None     # i32 [res_cap]
+    res_doc: jax.Array | None = None      # i32 [res_cap]
+
+    @property
+    def is_ell(self) -> bool:
+        return bool(self.ell_impacts) or self.tf is None
 
     def size_bytes(self) -> int:
-        return int(self.tf.nbytes + self.term.nbytes + self.doc.nbytes
-                   + self.doc_len.nbytes + self.df.nbytes)
+        arrays = [self.tf, self.term, self.doc, self.doc_len, self.df,
+                  self.res_tf, self.res_term, self.res_doc,
+                  *self.ell_impacts, *self.ell_terms]
+        return int(sum(a.nbytes for a in arrays if a is not None))
 
 
 jax.tree_util.register_dataclass(
     Snapshot,
     data_fields=["tf", "term", "doc", "doc_len", "df", "doc_norms",
-                 "n_docs", "avgdl", "num_docs"],
+                 "n_docs", "avgdl", "num_docs", "ell_impacts", "ell_terms",
+                 "ell_live", "res_tf", "res_term", "res_doc"],
     meta_fields=["doc_names", "version", "nnz", "host_coo"],
 )
 
@@ -83,11 +108,15 @@ class ShardIndex:
     def __init__(self, model: ScoringModel,
                  min_nnz_cap: int = 1 << 16,
                  min_doc_cap: int = 1024,
-                 keep_host_coo: bool = False) -> None:
+                 keep_host_coo: bool = False,
+                 layout: str = "ell",
+                 ell_width_cap: int = 256) -> None:
         self.model = model
         self.min_nnz_cap = min_nnz_cap
         self.min_doc_cap = min_doc_cap
         self.keep_host_coo = keep_host_coo
+        self.layout = layout          # "ell" (gather/MXU path) | "coo"
+        self.ell_width_cap = ell_width_cap
         self._docs: list[DocEntry] = []
         self._by_name: dict[str, int] = {}
         self._tombstones = 0
@@ -165,10 +194,16 @@ class ShardIndex:
         """Rebuild a host COO from live docs. Returns (coo, names, raw_len)."""
         with self._write_lock:
             live = [d for d in self._docs if d.live]
-        names = [d.name for d in live]
         n_live = len(live)
-        sizes = np.fromiter((d.term_ids.shape[0] for d in live),
-                            np.int64, n_live)
+        # rows sorted by distinct-term count DESC: the blocked-ELL layout
+        # packs same-width rows into dense blocks (tfidf_tpu.ops.ell); the
+        # stable sort keeps insertion order within a width for determinism
+        sizes0 = np.fromiter((d.term_ids.shape[0] for d in live),
+                             np.int64, n_live)
+        order = np.argsort(-sizes0, kind="stable")
+        live = [live[i] for i in order]
+        names = [d.name for d in live]
+        sizes = sizes0[order]
         nnz = int(sizes.sum()) if n_live else 0
         nnz_cap = next_capacity(max(nnz, 1), self.min_nnz_cap)
         doc_cap = next_capacity(max(n_live, 1), self.min_doc_cap)
@@ -203,29 +238,71 @@ class ShardIndex:
         n_live = len(names)
         kernel_len = self.model.transform_doc_len(
             coo.doc_len[:n_live].astype(np.float32))
-        doc_len_dev = np.zeros(coo.doc_cap, np.float32)
-        doc_len_dev[:n_live] = kernel_len
+        doc_len_host = np.zeros(coo.doc_cap, np.float32)
+        doc_len_host[:n_live] = kernel_len
 
-        tf = jnp.asarray(coo.tf)
-        term = jnp.asarray(coo.term)
-        doc = jnp.asarray(coo.doc)
         df = jnp.asarray(coo.df)
         n_docs = jnp.float32(n_live)
         # avgdl from exact lengths (Lucene: sumTotalTermFreq / docCount)
         total = float(raw_len[:n_live].sum())
         avgdl = jnp.float32(total / n_live if n_live else 1.0)
-        if self.model.needs_norms:
-            norms = cosine_norms(tf, term, doc, df, n_docs, coo.doc_cap)
+
+        if self.layout == "ell":
+            # blocked-ELL fast path: only impacts + term rows + the small
+            # residual ship to device — the COO never does
+            if self.model.needs_norms:
+                norms_host = cosine_norms_host(coo, float(n_live))
+            else:
+                norms_host = np.zeros(coo.doc_cap, np.float32)
+            norms = jnp.asarray(norms_host)
+            ell = build_ell_from_coo(
+                coo, width_cap=self.ell_width_cap,
+                min_rows=min(256, self.min_doc_cap))
+            impacts, terms, live = [], [], []
+            kw = self.model.score_kwargs()
+            for blk in ell.blocks:
+                rows_cap = blk.tf.shape[0]
+                dl_blk = np.zeros(rows_cap, np.float32)
+                dl_blk[:blk.n_rows] = doc_len_host[
+                    blk.row0:blk.row0 + blk.n_rows]
+                nrm_blk = np.zeros(rows_cap, np.float32)
+                nrm_blk[:blk.n_rows] = norms_host[
+                    blk.row0:blk.row0 + blk.n_rows]
+                # impacts precomputed once per commit (query path = pure
+                # gather + contract, no per-query BM25 math)
+                impacts.append(ell_impacts(
+                    jnp.asarray(blk.tf), jnp.asarray(blk.term),
+                    jnp.asarray(dl_blk), df, n_docs, avgdl,
+                    jnp.asarray(nrm_blk), **kw))
+                terms.append(jnp.asarray(blk.term))
+                live.append(blk.n_rows)
+            tf = term = doc = None
+            ell_kw: dict = dict(
+                ell_impacts=tuple(impacts), ell_terms=tuple(terms),
+                ell_live=jnp.asarray(np.asarray(live, np.int32)))
+            if ell.res_nnz:   # no spill -> no residual scoring pass at all
+                ell_kw.update(
+                    res_tf=jnp.asarray(ell.res_tf),
+                    res_term=jnp.asarray(ell.res_term),
+                    res_doc=jnp.asarray(ell.res_doc))
         else:
-            norms = jnp.zeros(coo.doc_cap, jnp.float32)
+            tf = jnp.asarray(coo.tf)
+            term = jnp.asarray(coo.term)
+            doc = jnp.asarray(coo.doc)
+            if self.model.needs_norms:
+                norms = cosine_norms(tf, term, doc, df, n_docs, coo.doc_cap)
+            else:
+                norms = jnp.zeros(coo.doc_cap, jnp.float32)
+            ell_kw = {}
         snap = Snapshot(
             tf=tf, term=term, doc=doc,
-            doc_len=jnp.asarray(doc_len_dev),
+            doc_len=jnp.asarray(doc_len_host),
             df=df, doc_norms=norms,
             n_docs=n_docs, avgdl=avgdl,
             num_docs=jnp.int32(n_live),
             doc_names=names, version=self._version, nnz=coo.nnz,
             host_coo=coo if self.keep_host_coo else None,
+            **ell_kw,
         )
         self.snapshot = snap
         # only as clean as the generation we actually built from — a write

@@ -24,8 +24,10 @@ from tfidf_tpu.engine.vocab import Vocabulary
 from tfidf_tpu.models.base import ScoringModel
 from tfidf_tpu.ops.analyzer import Analyzer
 from tfidf_tpu.ops.csr import next_capacity
-from tfidf_tpu.ops.scoring import score_coo_batch
-from tfidf_tpu.ops.topk import exact_topk, full_ranking
+from tfidf_tpu.ops.ell import score_ell_batch
+from tfidf_tpu.ops.scoring import (QueryBatch, make_query_batch,
+                                   score_coo_batch)
+from tfidf_tpu.ops.topk import full_ranking, packed_topk, unpack_topk
 from tfidf_tpu.utils.metrics import global_metrics
 from tfidf_tpu.utils.tracing import trace_phase
 
@@ -37,13 +39,13 @@ class SearchHit(NamedTuple):
 
 def vectorize_queries(queries: list[str], analyzer: Analyzer,
                       vocab: Vocabulary, model: ScoringModel,
-                      *, batch_cap: int, max_terms: int
-                      ) -> tuple[np.ndarray, np.ndarray]:
-    """Analyze + pad a query batch to [batch_cap, max_terms].
+                      *, batch_cap: int, max_terms: int) -> QueryBatch:
+    """Analyze + pad a query batch to [batch_cap, max_terms] and dedup the
+    batch's terms into a compact slot space (:class:`QueryBatch`).
 
-    Pad term id is 0 with weight 0 — inert by construction in the scoring
-    kernel. Queries with more than ``max_terms`` distinct terms keep the
-    highest-weight terms.
+    Pad entries are inert by construction in the scoring kernel. Queries
+    with more than ``max_terms`` distinct terms keep the highest-weight
+    terms.
     """
     assert len(queries) <= batch_cap
     q_terms = np.zeros((batch_cap, max_terms), np.int32)
@@ -56,7 +58,7 @@ def vectorize_queries(queries: list[str], analyzer: Analyzer,
         for j, (tid, w) in enumerate(items):
             q_terms[i, j] = tid
             q_weights[i, j] = w
-    return q_terms, q_weights
+    return make_query_batch(q_terms, q_weights)
 
 
 class Searcher:
@@ -102,15 +104,23 @@ class Searcher:
                       unbounded: bool) -> list[list[SearchHit]]:
         cap = self._batch_cap(len(queries))
         with trace_phase("vectorize"):
-            q_terms, q_weights = vectorize_queries(
+            qb = vectorize_queries(
                 queries, self.analyzer, self.vocab, self.model,
                 batch_cap=cap, max_terms=self.max_query_terms)
         with trace_phase("score"):
-            scores = score_coo_batch(
-                snap.tf, snap.term, snap.doc, snap.doc_len, snap.df,
-                jnp.asarray(q_terms), jnp.asarray(q_weights),
-                snap.n_docs, snap.avgdl, snap.doc_norms,
-                **self.model.score_kwargs())
+            if snap.is_ell:
+                # gather/MXU fast path: impacts precomputed at commit
+                scores = score_ell_batch(
+                    snap.ell_impacts, snap.ell_terms, snap.ell_live,
+                    snap.res_tf, snap.res_term, snap.res_doc,
+                    snap.doc_len, snap.df, qb,
+                    snap.n_docs, snap.avgdl, snap.doc_norms,
+                    **self.model.score_kwargs())
+            else:
+                scores = score_coo_batch(
+                    snap.tf, snap.term, snap.doc, snap.doc_len, snap.df,
+                    qb, snap.n_docs, snap.avgdl, snap.doc_norms,
+                    **self.model.score_kwargs())
         n_live = len(snap.doc_names)
         if unbounded:
             with trace_phase("rank_all"):
@@ -121,9 +131,10 @@ class Searcher:
         else:
             with trace_phase("topk"):
                 kk = min(k, n_live)
-                vals, ids = exact_topk(scores, snap.num_docs, k=kk)
-                vals = np.asarray(vals)
-                ids = np.asarray(ids)
+                # packed: ONE d2h transfer for values+ids (high-latency
+                # host<->device links make per-fetch cost dominate)
+                vals, ids = unpack_topk(
+                    packed_topk(scores, snap.num_docs, k=kk))
         results: list[list[SearchHit]] = []
         names = snap.doc_names
         for i in range(len(queries)):

@@ -28,9 +28,48 @@ executable is reused as the corpus grows within a capacity bucket.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+class QueryBatch(NamedTuple):
+    """A scoring-ready query batch with a deduplicated slot space.
+
+    Query terms are deduplicated on the host into ``uniq`` (the batch's
+    term dictionary, power-of-two bucketed); ``slots[b, t]`` indexes a
+    query entry's term in that dictionary, or ``len(uniq)`` (an inert
+    extra column) for padding. Keeps device-side query structures at
+    O(unique terms), not O(batch * terms) — essential for the large
+    batches TPUs want.
+    """
+
+    uniq: jax.Array      # i32 [U_cap] — unique term ids, zero-padded
+    n_uniq: jax.Array    # i32 scalar — live entries of `uniq` (traced)
+    slots: jax.Array     # i32 [B, T] — index into uniq, U_cap for pads
+    weights: jax.Array   # f32 [B, T] — query-side weights, 0 for pads
+
+
+def make_query_batch(q_terms: np.ndarray, q_weights: np.ndarray,
+                     *, min_slots: int = 256) -> QueryBatch:
+    """Host-side dedup of a padded [B, T] query batch into a QueryBatch."""
+    from tfidf_tpu.ops.csr import next_capacity
+
+    valid = q_weights > 0
+    uniq = (np.unique(q_terms[valid]) if valid.any()
+            else np.zeros(0, np.int64))
+    n = len(uniq)
+    u_cap = next_capacity(max(n, 1), min_slots)
+    uniq_pad = np.zeros(u_cap, np.int32)
+    uniq_pad[:n] = uniq
+    slots = np.full(q_terms.shape, u_cap, np.int32)
+    if n:
+        slots[valid] = np.searchsorted(
+            uniq, q_terms[valid]).astype(np.int32)
+    return QueryBatch(uniq=uniq_pad, n_uniq=np.int32(n), slots=slots,
+                      weights=q_weights.astype(np.float32))
 
 
 def lucene_idf(df: jax.Array, n_docs: jax.Array) -> jax.Array:
@@ -66,23 +105,27 @@ def tfidf_weights(tf: jax.Array, df_t: jax.Array,
     return tf * smooth_idf(df_t, n_docs)
 
 
-def _compile_queries(q_terms: jax.Array, q_weights: jax.Array,
+def _compile_queries(q: QueryBatch,
                      vocab_cap: int) -> tuple[jax.Array, jax.Array]:
-    """Build (slot_of [vocab_cap] i32, Qc_ext [B, S+1] f32).
+    """Build (slot_of [vocab_cap] i32, Qc_ext [B, U_cap+1] f32).
 
-    ``slot_of[v]`` is a slot s with ``flat_ids[s] == v`` (or S, the zero
-    column, if v appears in no query). ``Qc_ext[b, s]`` is query b's weight
-    for the term occupying slot s.
+    ``slot_of[v]`` maps a vocabulary id to its slot in the batch's term
+    dictionary (or U_cap, the zero column, if v appears in no query).
+    ``Qc_ext[b, u]`` is query b's total weight for dictionary term u.
     """
-    B, T = q_terms.shape
-    S = B * T
-    flat_ids = q_terms.reshape(S)
-    slot_of = (jnp.full((vocab_cap,), S, jnp.int32)
-               .at[flat_ids].set(jnp.arange(S, dtype=jnp.int32)))
-    eq = (q_terms[:, None, :] == flat_ids[None, :, None])     # [B, S, T]
-    qc = jnp.einsum("bst,bt->bs", eq.astype(q_weights.dtype), q_weights)
-    qc_ext = jnp.concatenate(
-        [qc, jnp.zeros((B, 1), q_weights.dtype)], axis=1)      # [B, S+1]
+    u_cap = q.uniq.shape[0]
+    B = q.slots.shape[0]
+    # pad entries of `uniq` scatter out-of-bounds and are dropped, so a
+    # real term id equal to the pad value (0) is never clobbered
+    idx = jnp.where(jnp.arange(u_cap) < q.n_uniq, q.uniq,
+                    jnp.int32(vocab_cap))
+    slot_of = (jnp.full((vocab_cap,), u_cap, jnp.int32)
+               .at[idx].set(jnp.arange(u_cap, dtype=jnp.int32),
+                            mode="drop"))
+    rows = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None],
+                            q.slots.shape)
+    qc_ext = (jnp.zeros((B, u_cap + 1), q.weights.dtype)
+              .at[rows, q.slots].add(q.weights))
     return slot_of, qc_ext
 
 
@@ -91,8 +134,7 @@ def score_coo_impl(tf: jax.Array,         # f32 [nnz_cap]
                     doc: jax.Array,       # i32 [nnz_cap], row-sorted
                     doc_len: jax.Array,   # f32 [doc_cap]
                     df: jax.Array,        # f32 [vocab_cap]
-                    q_terms: jax.Array,   # i32 [B, T], pad id 0
-                    q_weights: jax.Array, # f32 [B, T], pad weight 0
+                    q: QueryBatch,
                     n_docs: jax.Array,    # f32 scalar (traced: no recompiles)
                     avgdl: jax.Array,     # f32 scalar
                     doc_norms: jax.Array | None = None,  # f32 [doc_cap]
@@ -112,8 +154,8 @@ def score_coo_impl(tf: jax.Array,         # f32 [nnz_cap]
     assert nnz_cap % chunk == 0, (nnz_cap, chunk)
     n_chunks = nnz_cap // chunk
 
-    slot_of, qc_ext = _compile_queries(q_terms, q_weights, vocab_cap)
-    B = q_terms.shape[0]
+    slot_of, qc_ext = _compile_queries(q, vocab_cap)
+    B = q.slots.shape[0]
 
     def entry_weights(tf_c, term_c, doc_c):
         df_t = df[term_c]

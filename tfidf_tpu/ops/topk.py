@@ -53,6 +53,29 @@ def merge_topk(vals: jax.Array,   # f32 [..., n_parts, B, k]
     return top_vals, top_ids
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def packed_topk(scores: jax.Array, num_docs: jax.Array,
+                *, k: int) -> jax.Array:
+    """Top-k with values and (bitcast) indices packed into ONE f32 array
+    ``[B, 2k]`` — a single device-to-host transfer fetches both. Matters
+    when the host↔device link has high per-transfer latency (remote-TPU
+    tunnels); unpack with :func:`unpack_topk`."""
+    vals, idx = exact_topk(scores, num_docs, k=k)
+    return jnp.concatenate(
+        [vals, jax.lax.bitcast_convert_type(idx, jnp.float32)], axis=-1)
+
+
+def unpack_topk(packed) -> tuple:
+    """Host-side inverse of :func:`packed_topk` (one np.asarray fetch)."""
+    import numpy as np
+
+    arr = np.asarray(packed)
+    k = arr.shape[-1] // 2
+    vals = arr[..., :k]
+    ids = np.ascontiguousarray(arr[..., k:]).view(np.int32)
+    return vals, ids
+
+
 def full_ranking(scores: jax.Array, num_docs: int) -> tuple[jax.Array, jax.Array]:
     """All live documents sorted by descending score — the parity-mode analog
     of the reference's unbounded result set (host-side use only)."""

@@ -37,7 +37,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tfidf_tpu.ops.csr import CooShard, next_capacity
-from tfidf_tpu.ops.scoring import cosine_norms, score_coo_impl
+from tfidf_tpu.ops.scoring import (QueryBatch, cosine_norms,
+                                   score_coo_impl)
 from tfidf_tpu.ops.topk import exact_topk, merge_topk
 
 
@@ -193,7 +194,9 @@ def make_sharded_search(mesh: Mesh,
     for parity testing.
     """
 
-    def step(tf, term, doc, doc_len, df, n_live, q_terms, q_weights):
+    def step(tf, term, doc, doc_len, df, n_live,
+             q_uniq, q_n_uniq, q_slots, q_weights):
+        q = QueryBatch(q_uniq, q_n_uniq, q_slots, q_weights)
         tf = tf.reshape(tf.shape[-1])
         term = term.reshape(term.shape[-1])
         doc = doc.reshape(doc.shape[-1])
@@ -226,7 +229,7 @@ def make_sharded_search(mesh: Mesh,
             doc_norms = jnp.sqrt(jax.lax.psum(sq, "terms"))
 
         partial = score_coo_impl(
-            tf, term, doc, doc_len, df_eff, q_terms, q_weights,
+            tf, term, doc, doc_len, df_eff, q,
             n_eff, avgdl, doc_norms, model=model, k1=k1, b=b, chunk=chunk)
 
         scores = jax.lax.psum(partial, "terms")        # [B, doc_cap]
@@ -245,15 +248,17 @@ def make_sharded_search(mesh: Mesh,
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", None),
                   P("docs", "terms", None), P("docs"),
-                  P(None, None), P(None, None)),
+                  P(None), P(), P(None, None), P(None, None)),
         out_specs=(P(), P()),
         check_vma=False,
     )
 
     @jax.jit
-    def search(arrays: ShardedArrays, q_terms, q_weights):
+    def search(arrays: ShardedArrays, q: QueryBatch):
         return sharded(arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
-                       arrays.df, arrays.n_live, q_terms, q_weights)
+                       arrays.df, arrays.n_live,
+                       jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
+                       jnp.asarray(q.slots), jnp.asarray(q.weights))
 
     return search
 

@@ -64,6 +64,12 @@ class Config:
     min_nnz_capacity: int = 1 << 16
     min_vocab_capacity: int = 1 << 15
 
+    # --- scoring layout ---
+    # "ell": padded rows-by-document, gather/MXU scoring with precomputed
+    #        impacts (TPU fast path). "coo": chunked scatter scoring.
+    scoring_layout: str = "ell"
+    ell_width_cap: int = 256   # max ELL row width; longer docs spill to COO
+
     # --- misc ---
     log_level: str = "INFO"
     seed: int = 0

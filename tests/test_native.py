@@ -1,0 +1,213 @@
+"""Native C++ ingest path: bit-exact parity with the Python analyzer.
+
+The native tokenizer must produce exactly the Python chain's output for
+every ASCII input (tokenization quirks included), fall back cleanly for
+non-ASCII, and plug into the engine with identical end-to-end results.
+"""
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import native
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.ops.analyzer import Analyzer
+from tfidf_tpu.utils.config import Config
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+TRICKY = [
+    "the quick brown fox",
+    "can't won't it's o'clock",
+    "3.14 1,000 1.2.3 42",
+    "3abc abc3 a_b_c __x__",
+    "don''t a''b trailing' 'leading",
+    "1. 2, 3.x .5 ,7",
+    "  MIXED Case TeXT  ",
+    "a'b'c'd",
+    "",
+    "!!! ???",
+    "x" * 600,                      # > max_token_length, splits
+    "word " * 50 + "word",
+    "tabs\tand\nnewlines\r\nhere",
+    "under_score_9 9_to_5",
+]
+
+
+def py_counts(text, **kw):
+    a = Analyzer(**kw)
+    return {t: float(c) for t, c in a.counts(text).items()}
+
+
+class TestTokenizerParity:
+    @pytest.mark.parametrize("text", TRICKY)
+    def test_counts_match_python(self, text):
+        ne = native.NativeEngine()
+        ids, tfs, length = ne.analyze(text, add=True)
+        terms = ne.dump_terms()
+        got = {terms[int(i)]: float(f) for i, f in zip(ids, tfs)}
+        want = py_counts(text)
+        assert got == want, (got, want)
+        assert length == sum(want.values())
+        assert list(ids) == sorted(ids)
+
+    def test_stopwords_and_caps(self):
+        kw = dict(stopwords=("the", "and"), max_token_length=4)
+        ne = native.NativeEngine(stopwords=("the", "and"),
+                                 max_token_length=4)
+        text = "the miserable and gigantic theand"
+        ids, tfs, _ = ne.analyze(text, add=True)
+        terms = ne.dump_terms()
+        got = {terms[int(i)]: float(f) for i, f in zip(ids, tfs)}
+        want = py_counts(text, stopwords=frozenset(("the", "and")),
+                         max_token_length=4)
+        assert got == want
+
+    def test_no_lowercase(self):
+        ne = native.NativeEngine(lowercase=False)
+        ids, tfs, _ = ne.analyze("Foo foo FOO", add=True)
+        assert len(ids) == 3
+
+    def test_non_ascii_falls_back(self):
+        ne = native.NativeEngine()
+        assert ne.analyze("café crème", add=True) is None
+
+    def test_query_lookup_does_not_add(self):
+        ne = native.NativeEngine()
+        ne.analyze("alpha beta", add=True)
+        ids, tfs, _ = ne.analyze("alpha gamma", add=False)
+        terms = ne.dump_terms()
+        assert terms == ["alpha", "beta"]       # gamma not added
+        assert [terms[int(i)] for i in ids] == ["alpha"]
+
+    def test_buffer_growth(self):
+        ne = native.NativeEngine()
+        text = " ".join(f"tok{i}" for i in range(10_000))
+        ids, tfs, length = ne.analyze(text, add=True)
+        assert len(ids) == 10_000
+        assert length == 10_000.0
+
+    def test_random_ascii_fuzz(self, rng):
+        import string
+        alphabet = string.ascii_letters + string.digits + "_'., \t\n-!?"
+        ne = native.NativeEngine()
+        for _ in range(50):
+            n = int(rng.integers(0, 200))
+            text = "".join(rng.choice(list(alphabet)) for _ in range(n))
+            got_raw = ne.analyze(text, add=True)
+            terms = ne.dump_terms()
+            got = {terms[int(i)]: float(f)
+                   for i, f in zip(got_raw[0], got_raw[1])}
+            assert got == py_counts(text), repr(text)
+
+
+class TestEngineIntegration:
+    def _cfg(self, tmp_path, sub, **kw):
+        return Config(documents_path=str(tmp_path / sub),
+                      min_doc_capacity=8, min_nnz_capacity=256,
+                      min_vocab_capacity=64, query_batch=4,
+                      max_query_terms=8, **kw)
+
+    def test_native_engine_matches_python_engine(self, tmp_path):
+        texts = {
+            "a.txt": "the quick brown fox jumps over the lazy dog",
+            "b.txt": "a fast brown fox and a quick red fox",
+            "c.txt": "café crème brûlée",   # non-ASCII
+            "d.txt": "numbers 3.14 and 1,000 don't lie",
+        }
+        results = {}
+        for flag in (True, False):
+            e = Engine(self._cfg(tmp_path, str(flag), native_ingest=flag))
+            if flag:
+                assert e.native is not None
+            for nm, tx in texts.items():
+                e.ingest_text(nm, tx)
+            e.commit()
+            results[flag] = [e.search(q)
+                             for q in ("fox", "café", "3.14", "don't")]
+        for hits_n, hits_p in zip(results[True], results[False]):
+            assert [h.name for h in hits_n] == [h.name for h in hits_p]
+            np.testing.assert_allclose([h.score for h in hits_n],
+                                       [h.score for h in hits_p],
+                                       rtol=1e-6)
+
+    def test_capacity_tracks_native_vocab(self, tmp_path):
+        """Regression: NativeVocabulary.capacity() must grow with the
+        NATIVE table size, not the (empty) base-class term list — a stuck
+        capacity silently truncates df and drops query terms."""
+        cfg = self._cfg(tmp_path, "cap")
+        e = Engine(cfg)
+        text = " ".join(f"w{i}" for i in range(200))   # >> min_vocab 64
+        e.ingest_text("big.txt", text)
+        assert len(e.vocab) > 64
+        assert e.vocab.capacity() >= len(e.vocab) + 1
+        e.commit()
+        # a term with id above the old minimum bucket must be searchable
+        assert [h.name for h in e.search("w199")] == ["big.txt"]
+
+    def test_term_accessor(self):
+        ne = native.NativeEngine()
+        ne.analyze("alpha beta", add=True)
+        assert ne.term(0) == "alpha" and ne.term(1) == "beta"
+        with pytest.raises(IndexError):
+            ne.term(7)
+
+    def test_concurrent_ingest_and_search(self, tmp_path):
+        """The native path must survive concurrent upload handlers +
+        searches (ThreadingHTTPServer reality): no crashes, consistent
+        final vocabulary."""
+        import threading
+        cfg = self._cfg(tmp_path, "conc")
+        e = Engine(cfg)
+        errs = []
+
+        def ingest(lo):
+            try:
+                for i in range(lo, lo + 50):
+                    e.ingest_text(f"d{i}.txt",
+                                  f"shared tokens plus unique{i} here")
+            except Exception as ex:
+                errs.append(ex)
+
+        def search():
+            try:
+                for _ in range(30):
+                    e.vocab.lookup("shared")
+                    e.search("shared tokens")
+            except Exception as ex:
+                errs.append(ex)
+
+        threads = [threading.Thread(target=ingest, args=(k * 50,))
+                   for k in range(4)] + [threading.Thread(target=search)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        e.commit()
+        assert len({h.name for h in e.search("shared", k=500)}) == 200
+
+    def test_checkpoint_roundtrip_native(self, tmp_path):
+        from tfidf_tpu.engine.checkpoint import (load_checkpoint,
+                                                 save_checkpoint)
+        cfg = self._cfg(tmp_path, "ck")
+        e = Engine(cfg)
+        e.ingest_text("x.txt", "hello world hello")
+        e.ingest_text("y.txt", "café hello")
+        e.commit()
+        save_checkpoint(e, str(tmp_path / "ckpt"))
+        e2 = load_checkpoint(str(tmp_path / "ckpt"), cfg)
+        assert e2.native is not None
+        # restored vocab is shared with the native table: new ingest
+        # reuses existing ids
+        assert e2.vocab.lookup("hello") == e.vocab.lookup("hello")
+        h1 = e.search("hello")
+        h2 = e2.search("hello")
+        assert [h.name for h in h1] == [h.name for h in h2]
+        np.testing.assert_allclose([h.score for h in h1],
+                                   [h.score for h in h2], rtol=1e-6)
+        # ingest after restore goes through the native path consistently
+        e2.ingest_text("z.txt", "hello again")
+        e2.commit()
+        assert {h.name for h in e2.search("hello")} == {
+            "x.txt", "y.txt", "z.txt"}

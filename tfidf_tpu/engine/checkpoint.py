@@ -29,7 +29,6 @@ import shutil
 import numpy as np
 
 from tfidf_tpu.engine.engine import Engine
-from tfidf_tpu.engine.vocab import Vocabulary
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.faults import fault_point
 from tfidf_tpu.utils.logging import get_logger
@@ -107,9 +106,9 @@ def load_checkpoint(directory: str, config: Config | None = None) -> Engine:
     if meta["model"] != config.model:
         config = config.replace(model=meta["model"])
     engine = Engine(config)
-    engine.vocab = Vocabulary.load(os.path.join(directory, "vocab.txt"),
-                                   min_capacity=config.min_vocab_capacity)
-    engine.searcher.vocab = engine.vocab
+    # populate the engine's OWN vocabulary (which may be native-backed) so
+    # later ingests through either path see the restored terms
+    engine.vocab.load_into(os.path.join(directory, "vocab.txt"))
     data = np.load(os.path.join(directory, "docs.npz"))
     with open(os.path.join(directory, "names.json"), encoding="utf-8") as f:
         names = json.load(f)

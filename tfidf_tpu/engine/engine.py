@@ -17,7 +17,7 @@ import os
 
 from tfidf_tpu.engine.index import ShardIndex
 from tfidf_tpu.engine.searcher import Searcher, SearchHit
-from tfidf_tpu.engine.vocab import Vocabulary
+from tfidf_tpu.engine.vocab import NativeVocabulary, Vocabulary
 from tfidf_tpu.models.base import get_model
 from tfidf_tpu.ops.analyzer import Analyzer, extract_text
 from tfidf_tpu.utils.config import Config
@@ -37,7 +37,21 @@ class Engine:
             max_token_length=c.max_token_length)
         self.model = get_model(c.model, k1=c.bm25_k1, b=c.bm25_b,
                                lucene_parity=c.lucene_parity)
-        self.vocab = Vocabulary(min_capacity=c.min_vocab_capacity)
+        # native C++ ingest fast path (tokenize+count+id-map in one call);
+        # non-ASCII documents and unavailable-compiler environments fall
+        # back to the pure-Python chain with identical results
+        self.native = None
+        if c.native_ingest:
+            from tfidf_tpu import native as native_mod
+            if native_mod.available():
+                self.native = native_mod.NativeEngine(
+                    lowercase=c.lowercase, stopwords=tuple(c.stopwords),
+                    max_token_length=c.max_token_length)
+        if self.native is not None:
+            self.vocab = NativeVocabulary(
+                self.native, min_capacity=c.min_vocab_capacity)
+        else:
+            self.vocab = Vocabulary(min_capacity=c.min_vocab_capacity)
         self.index = ShardIndex(
             self.model,
             min_nnz_cap=c.min_nnz_capacity,
@@ -53,6 +67,12 @@ class Engine:
 
     def ingest_text(self, name: str, text: str) -> None:
         with trace_phase("analyze"):
+            if self.native is not None:
+                res = self.native.analyze(text, add=True)
+                if res is not None:
+                    ids, tfs, length = res
+                    self.index.add_document_arrays(name, ids, tfs, length)
+                    return
             counts = self.analyzer.counts(text)
             length = float(sum(counts.values()))
             id_counts = self.vocab.map_counts(counts, add=True)

@@ -141,8 +141,16 @@ class ShardIndex:
         else:
             ids = np.empty(0, np.int32)
             tfs = np.empty(0, np.float32)
+        self.add_document_arrays(name, ids, tfs, length)
+
+    def add_document_arrays(self, name: str, ids: np.ndarray,
+                            tfs: np.ndarray,
+                            length: float | None = None) -> None:
+        """Upsert from pre-sorted id/tf arrays (the native ingest path
+        produces these directly — no dict round-trip)."""
         entry = DocEntry(
-            name=name, term_ids=ids, tfs=tfs,
+            name=name, term_ids=np.asarray(ids, np.int32),
+            tfs=np.asarray(tfs, np.float32),
             length=float(length if length is not None else tfs.sum()))
         with self._write_lock:
             old = self._by_name.get(name)

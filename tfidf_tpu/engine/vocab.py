@@ -27,8 +27,9 @@ class Vocabulary:
 
     def capacity(self) -> int:
         """Current power-of-two device capacity bucket (>= len + 1 so id 0's
-        pad-collision trick in scoring always has headroom)."""
-        return next_capacity(len(self._terms) + 1, self._min_capacity)
+        pad-collision trick in scoring always has headroom). Uses len(self)
+        — overridable — so backend subclasses report their true size."""
+        return next_capacity(len(self) + 1, self._min_capacity)
 
     def add(self, term: str) -> int:
         tid = self._ids.get(term)
@@ -44,6 +45,10 @@ class Vocabulary:
     def term(self, tid: int) -> str:
         return self._terms[tid]
 
+    def all_terms(self) -> list[str]:
+        """Every term in id order (overridable backend accessor)."""
+        return self._terms
+
     def map_counts(self, counts: dict[str, int], *,
                    add: bool) -> dict[int, int]:
         """Map a term->freq dict to id->freq. With ``add=False`` (query
@@ -51,7 +56,7 @@ class Vocabulary:
         exactly like an out-of-dictionary term in Lucene."""
         out: dict[int, int] = {}
         for term, c in counts.items():
-            tid = self.add(term) if add else self._ids.get(term)
+            tid = self.add(term) if add else self.lookup(term)
             if tid is not None:
                 out[tid] = out.get(tid, 0) + c
         return out
@@ -59,14 +64,45 @@ class Vocabulary:
     def save(self, path: str) -> None:
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            for t in self._terms:
+            for t in self.all_terms():
                 f.write(t + "\n")
         os.replace(tmp, path)
+
+    def load_into(self, path: str) -> None:
+        """Append every term from a vocab file, in order (checkpoint
+        restore). Works for any backend — terms go through ``add``."""
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                self.add(line.rstrip("\n"))
 
     @classmethod
     def load(cls, path: str, min_capacity: int = 1 << 15) -> "Vocabulary":
         v = cls(min_capacity)
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                v.add(line.rstrip("\n"))
+        v.load_into(path)
         return v
+
+
+class NativeVocabulary(Vocabulary):
+    """Vocabulary view over the native C++ term table
+    (:class:`tfidf_tpu.native.NativeEngine`) — the ingest fast path adds
+    terms natively; this adapter keeps the Python API (queries,
+    checkpoints, debugging) on the same table."""
+
+    def __init__(self, native, min_capacity: int = 1 << 15) -> None:
+        super().__init__(min_capacity)
+        self._native = native
+
+    def __len__(self) -> int:
+        return self._native.vocab_size()
+
+    def add(self, term: str) -> int:
+        return self._native.lookup(term, add=True)
+
+    def lookup(self, term: str) -> int | None:
+        return self._native.lookup(term, add=False)
+
+    def term(self, tid: int) -> str:
+        return self._native.term(tid)
+
+    def all_terms(self) -> list[str]:
+        return self._native.dump_terms()

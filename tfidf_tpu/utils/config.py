@@ -70,6 +70,12 @@ class Config:
     scoring_layout: str = "ell"
     ell_width_cap: int = 256   # max ELL row width; longer docs spill to COO
 
+    # --- ingest ---
+    # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back
+    # to the pure-Python analyzer when no compiler is available or for
+    # non-ASCII documents — results are identical either way.
+    native_ingest: bool = True
+
     # --- misc ---
     log_level: str = "INFO"
     seed: int = 0

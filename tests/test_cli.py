@@ -1,0 +1,127 @@
+"""CLI tests: local ingest/search commands and the serve loop's wiring.
+
+Covers the single-binary surface (the reference's fat-jar role): ingest a
+directory, search it, checkpoint round-trip through flags, and client
+commands against an in-process cluster node.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tfidf_tpu.cli import build_parser, main
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    d = tmp_path / "docs"
+    d.mkdir()
+    (d / "a.txt").write_text("the quick brown fox")
+    (d / "b.txt").write_text("lazy dogs sleep all day")
+    return str(d)
+
+
+def run_cli(capsys, *argv):
+    rc = main(list(argv))
+    out = capsys.readouterr().out.strip()
+    return rc, out
+
+
+class TestLocalCommands:
+    def test_ingest_then_search(self, tmp_path, corpus, capsys):
+        rc, out = run_cli(capsys, "ingest", corpus,
+                          "--documents-path", corpus)
+        assert rc == 0
+        assert json.loads(out)["docs"] == 2
+
+        rc, out = run_cli(capsys, "search", "fox",
+                          "--documents-path", corpus)
+        assert rc == 0
+        res = json.loads(out)
+        assert res["query"] == "fox"
+        assert [h["name"] for h in res["hits"]] == ["a.txt"]
+
+    def test_checkpoint_flags(self, tmp_path, corpus, capsys):
+        ckpt = str(tmp_path / "ckpt")
+        rc, out = run_cli(capsys, "ingest", corpus,
+                          "--documents-path", corpus,
+                          "--checkpoint", ckpt)
+        assert rc == 0 and os.path.exists(ckpt)
+        rc, out = run_cli(capsys, "search", "dogs",
+                          "--checkpoint", ckpt)
+        hits = json.loads(out)["hits"]
+        assert [h["name"] for h in hits] == ["b.txt"]
+
+    def test_config_file_and_flags(self, tmp_path, corpus, capsys):
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps({"model": "tfidf",
+                                   "documents_path": corpus}))
+        rc, out = run_cli(capsys, "--config", str(cfg), "search", "fox")
+        assert rc == 0
+        assert json.loads(out)["hits"][0]["name"] == "a.txt"
+
+    def test_parser_rejects_unknown(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["frobnicate"])
+
+
+class TestClusterClientCommands:
+    def test_upload_query_status(self, tmp_path, corpus, capsys):
+        from tfidf_tpu.cluster.coordination import (CoordinationCore,
+                                                    LocalCoordination)
+        from tfidf_tpu.cluster.node import SearchNode
+        from tfidf_tpu.utils.config import Config
+
+        core = CoordinationCore(session_timeout_s=2.0)
+        nodes = []
+        try:
+            for i in range(2):
+                c = Config(
+                    documents_path=str(tmp_path / f"n{i}" / "docs"),
+                    index_path=str(tmp_path / f"n{i}" / "idx"),
+                    port=0, min_doc_capacity=8, min_nnz_capacity=256,
+                    min_vocab_capacity=64, query_batch=4,
+                    max_query_terms=8)
+                nodes.append(SearchNode(
+                    c, coord=LocalCoordination(core, 0.3)).start())
+            leader = nodes[0]
+            deadline = time.monotonic() + 5
+            while (not leader.registry.get_all_service_addresses()
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+
+            f = tmp_path / "up.txt"
+            f.write_text("zebra crossing stripes")
+            rc, out = run_cli(capsys, "upload", str(f),
+                              "--leader", leader.url)
+            assert rc == 0 and "uploaded" in out
+
+            # filenames with spaces must be URL-encoded by the client
+            g = tmp_path / "my doc.txt"
+            g.write_text("quagga herds")
+            rc, out = run_cli(capsys, "upload", str(g),
+                              "--leader", leader.url)
+            assert rc == 0 and "uploaded" in out
+            rc, out = run_cli(capsys, "query", "quagga",
+                              "--leader", leader.url)
+            assert "my doc.txt" in json.loads(out)
+
+            rc, out = run_cli(capsys, "query", "zebra",
+                              "--leader", leader.url)
+            assert rc == 0
+            assert "up.txt" in json.loads(out)
+
+            rc, out = run_cli(capsys, "status", "--leader", leader.url)
+            st = json.loads(out)
+            assert st["status"] == "I am the leader"
+            assert st["services"] == [nodes[1].url]
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+            core.close()

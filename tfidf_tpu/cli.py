@@ -1,0 +1,261 @@
+"""Command-line interface — the single-binary deployment surface.
+
+The reference ships one Spring Boot fat jar that every node runs
+(``app/ZookeeperLeaderElectionApplication.java``; k8s Deployment in
+``README.MD:49-108``). The equivalent here is ``python -m tfidf_tpu``:
+
+    serve        run a cluster node (worker + leader-candidate), optionally
+                 with an embedded coordination service
+    coordinator  run only the coordination service (the "zookeeper" pod)
+    ingest       build a local index from files/directories
+    search       query a local index
+    upload       client: send a document to a running cluster's leader
+    query        client: search a running cluster
+    status       client: node role + live membership
+    bench        run the TPU benchmark
+
+Config resolution (lowest to highest): dataclass defaults, --config JSON
+file, TFIDF_* environment variables, explicit flags — mirroring the
+reference's application.properties + env override scheme (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import urllib.parse
+
+from tfidf_tpu.utils.config import Config, load_config
+from tfidf_tpu.utils.logging import get_logger
+
+log = get_logger("cli")
+
+
+def _load_cfg(args, **overrides) -> Config:
+    for name in ("host", "port", "documents_path", "index_path",
+                 "coordinator_address", "model", "result_order"):
+        v = getattr(args, name.replace("-", "_"), None)
+        if v is not None:
+            overrides[name] = v
+    return load_config(getattr(args, "config", None), **overrides)
+
+
+def cmd_serve(args) -> int:
+    from tfidf_tpu.cluster.coordination import (CoordinationClient,
+                                                CoordinationServer)
+    from tfidf_tpu.cluster.node import SearchNode
+
+    cfg = _load_cfg(args)
+    server = None
+    if args.embedded_coordinator:
+        host, _, port = cfg.coordinator_address.partition(":")
+        server = CoordinationServer(
+            host=host or "127.0.0.1", port=int(port or 0),
+            session_timeout_s=cfg.session_timeout_s).start()
+        cfg = cfg.replace(coordinator_address=server.address)
+        log.info("embedded coordination service", address=server.address)
+
+    def factory():
+        return CoordinationClient(
+            cfg.coordinator_address,
+            heartbeat_interval_s=cfg.heartbeat_interval_s)
+
+    node = SearchNode(cfg, coord_factory=factory).start()
+    print(f"node up at {node.url} "
+          f"({'leader' if node.is_leader() else 'worker'}); "
+          f"coordinator {cfg.coordinator_address}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()   # the main thread parks, like Application.runApplication
+    node.stop()
+    if server is not None:
+        server.close()
+    return 0
+
+
+def cmd_coordinator(args) -> int:
+    from tfidf_tpu.cluster.coordination import CoordinationServer
+
+    cfg = _load_cfg(args)
+    host, _, port = (args.listen or "0.0.0.0:2181").partition(":")
+    server = CoordinationServer(
+        host=host, port=int(port or 2181),
+        session_timeout_s=cfg.session_timeout_s).start()
+    print(f"coordination service at {server.address}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.close()
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    from tfidf_tpu.engine.checkpoint import save_checkpoint
+    from tfidf_tpu.engine.engine import Engine
+
+    cfg = _load_cfg(args)
+    engine = Engine(cfg)
+    n = 0
+    for path in args.paths:
+        if os.path.isdir(path):
+            # ingest files only; one commit at the end covers everything
+            for dirpath, _dirnames, filenames in sorted(os.walk(path)):
+                for fn in sorted(filenames):
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(full, path)
+                    with open(full, "rb") as f:
+                        engine.ingest_bytes(rel, f.read())
+                    n += 1
+        else:
+            with open(path, "rb") as f:
+                engine.ingest_bytes(os.path.basename(path), f.read(),
+                                    save_to_disk=True)
+            n += 1
+    engine.commit()
+    if args.checkpoint:
+        save_checkpoint(engine, args.checkpoint)
+    print(json.dumps({"docs": n, "vocab": len(engine.vocab),
+                      "nnz": engine.index.snapshot.nnz}))
+    return 0
+
+
+def cmd_search(args) -> int:
+    from tfidf_tpu.engine.checkpoint import load_checkpoint
+    from tfidf_tpu.engine.engine import Engine
+
+    cfg = _load_cfg(args)
+    if args.checkpoint:
+        engine = load_checkpoint(args.checkpoint, cfg)
+    else:
+        engine = Engine(cfg)
+        engine.build_from_directory()
+    for q in args.queries:
+        hits = engine.search(q, k=args.k)
+        print(json.dumps({"query": q,
+                          "hits": [{"name": h.name, "score": h.score}
+                                   for h in hits]}))
+    return 0
+
+
+def _leader_url(args) -> str:
+    return args.leader.rstrip("/")
+
+
+def cmd_upload(args) -> int:
+    from tfidf_tpu.cluster.node import http_post
+
+    for path in args.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        name = urllib.parse.quote(os.path.basename(path))
+        resp = http_post(_leader_url(args) + f"/leader/upload?name={name}",
+                         data, content_type="application/octet-stream")
+        print(resp.decode())
+    return 0
+
+
+def cmd_query(args) -> int:
+    from tfidf_tpu.cluster.node import http_post
+
+    body = json.dumps({"query": " ".join(args.query)}).encode()
+    resp = http_post(_leader_url(args) + "/leader/start", body)
+    print(resp.decode())
+    return 0
+
+
+def cmd_status(args) -> int:
+    from tfidf_tpu.cluster.node import http_get
+
+    url = _leader_url(args)
+    out = {"status": http_get(url + "/api/status").decode(),
+           "services": json.loads(http_get(url + "/api/services")),
+           "metrics": json.loads(http_get(url + "/api/metrics"))}
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    # bench.py lives at the repo root, not inside the package
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.exists(os.path.join(root, "bench.py")):
+        print("bench.py not found (requires a repo checkout)",
+              file=sys.stderr)
+        return 1
+    sys.path.insert(0, root)
+    import bench
+
+    bench.main()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tfidf_tpu",
+        description="TPU-native distributed full-text search framework")
+    p.add_argument("--config", help="JSON config file")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="run a cluster node")
+    s.add_argument("--host")
+    s.add_argument("--port", type=int)
+    s.add_argument("--documents-path")
+    s.add_argument("--index-path")
+    s.add_argument("--coordinator-address")
+    s.add_argument("--model", choices=["bm25", "tfidf", "tfidf_cosine"])
+    s.add_argument("--result-order", choices=["score", "name"])
+    s.add_argument("--embedded-coordinator", action="store_true",
+                   help="also run the coordination service in-process")
+    s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("coordinator", help="run the coordination service")
+    s.add_argument("--listen", help="host:port (default 0.0.0.0:2181)")
+    s.set_defaults(fn=cmd_coordinator)
+
+    s = sub.add_parser("ingest", help="index files/dirs locally")
+    s.add_argument("paths", nargs="+")
+    s.add_argument("--documents-path")
+    s.add_argument("--checkpoint", help="save a checkpoint here")
+    s.add_argument("--model", choices=["bm25", "tfidf", "tfidf_cosine"])
+    s.set_defaults(fn=cmd_ingest)
+
+    s = sub.add_parser("search", help="query a local index")
+    s.add_argument("queries", nargs="+")
+    s.add_argument("-k", type=int, default=10)
+    s.add_argument("--documents-path")
+    s.add_argument("--checkpoint", help="load this checkpoint")
+    s.add_argument("--model", choices=["bm25", "tfidf", "tfidf_cosine"])
+    s.set_defaults(fn=cmd_search)
+
+    s = sub.add_parser("upload", help="upload documents to a cluster")
+    s.add_argument("files", nargs="+")
+    s.add_argument("--leader", required=True, help="leader base URL")
+    s.set_defaults(fn=cmd_upload)
+
+    s = sub.add_parser("query", help="search a running cluster")
+    s.add_argument("query", nargs="+")
+    s.add_argument("--leader", required=True)
+    s.set_defaults(fn=cmd_query)
+
+    s = sub.add_parser("status", help="node role + membership + metrics")
+    s.add_argument("--leader", required=True, help="any node's base URL")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("bench", help="run the TPU benchmark")
+    s.set_defaults(fn=cmd_bench)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

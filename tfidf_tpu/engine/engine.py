@@ -16,6 +16,7 @@ from __future__ import annotations
 import os
 
 from tfidf_tpu.engine.index import ShardIndex
+from tfidf_tpu.engine.segments import SegmentedIndex
 from tfidf_tpu.engine.searcher import Searcher, SearchHit
 from tfidf_tpu.engine.vocab import NativeVocabulary, Vocabulary
 from tfidf_tpu.models.base import get_model
@@ -52,12 +53,19 @@ class Engine:
                 self.native, min_capacity=c.min_vocab_capacity)
         else:
             self.vocab = Vocabulary(min_capacity=c.min_vocab_capacity)
-        self.index = ShardIndex(
-            self.model,
-            min_nnz_cap=c.min_nnz_capacity,
-            min_doc_cap=c.min_doc_capacity,
-            layout=c.scoring_layout,
-            ell_width_cap=c.ell_width_cap)
+        if c.index_mode == "segments":
+            self.index = SegmentedIndex(
+                self.model,
+                min_doc_cap=c.min_doc_capacity,
+                ell_width_cap=c.ell_width_cap,
+                max_segments=c.max_segments)
+        else:
+            self.index = ShardIndex(
+                self.model,
+                min_nnz_cap=c.min_nnz_capacity,
+                min_doc_cap=c.min_doc_capacity,
+                layout=c.scoring_layout,
+                ell_width_cap=c.ell_width_cap)
         self.searcher = Searcher(
             self.index, self.analyzer, self.vocab, self.model,
             query_batch=c.query_batch, max_query_terms=c.max_query_terms,

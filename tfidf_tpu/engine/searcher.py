@@ -20,11 +20,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from tfidf_tpu.engine.index import ShardIndex, Snapshot
+from tfidf_tpu.engine.segments import SegmentedSnapshot
 from tfidf_tpu.engine.vocab import Vocabulary
 from tfidf_tpu.models.base import ScoringModel
 from tfidf_tpu.ops.analyzer import Analyzer
 from tfidf_tpu.ops.csr import next_capacity
-from tfidf_tpu.ops.ell import score_ell_batch
+from tfidf_tpu.ops.ell import score_ell_batch, score_segments_batch
 from tfidf_tpu.ops.scoring import (QueryBatch, make_query_batch,
                                    score_coo_batch)
 from tfidf_tpu.ops.topk import full_ranking, packed_topk, unpack_topk
@@ -108,7 +109,14 @@ class Searcher:
                 queries, self.analyzer, self.vocab, self.model,
                 batch_cap=cap, max_terms=self.max_query_terms)
         with trace_phase("score"):
-            if snap.is_ell:
+            if isinstance(snap, SegmentedSnapshot):
+                seg_data = tuple(
+                    (s.tfs, s.terms, s.dls, s.norms, s.block_live,
+                     s.live_mask) for s in snap.segments)
+                scores = score_segments_batch(
+                    seg_data, snap.df, qb, snap.n_docs, snap.avgdl,
+                    **self.model.score_kwargs())
+            elif snap.is_ell:
                 # gather/MXU fast path: impacts precomputed at commit
                 scores = score_ell_batch(
                     snap.ell_impacts, snap.ell_terms, snap.ell_live,
@@ -121,13 +129,17 @@ class Searcher:
                     snap.tf, snap.term, snap.doc, snap.doc_len, snap.df,
                     qb, snap.n_docs, snap.avgdl, snap.doc_norms,
                     **self.model.score_kwargs())
+        segmented = isinstance(snap, SegmentedSnapshot)
         n_live = len(snap.doc_names)
         if unbounded:
             with trace_phase("rank_all"):
-                vals, ids = full_ranking(scores, n_live)
+                # segmented doc ids interleave padding, so rank the whole
+                # padded space (pads score 0 and are filtered below)
+                rank_n = scores.shape[-1] if segmented else n_live
+                vals, ids = full_ranking(scores, rank_n)
                 vals = np.asarray(vals)
                 ids = np.asarray(ids)
-                kk = n_live
+                kk = rank_n
         else:
             with trace_phase("topk"):
                 kk = min(k, n_live)
@@ -136,7 +148,7 @@ class Searcher:
                 vals, ids = unpack_topk(
                     packed_topk(scores, snap.num_docs, k=kk))
         results: list[list[SearchHit]] = []
-        names = snap.doc_names
+        names = snap.padded_names if segmented else snap.doc_names
         for i in range(len(queries)):
             hits = [SearchHit(names[int(d)], float(v))
                     for v, d in zip(vals[i, :kk], ids[i, :kk])

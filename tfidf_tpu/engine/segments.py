@@ -1,0 +1,378 @@
+"""Streaming segment index — Lucene's segment model, TPU-native.
+
+The rebuild-style :class:`~tfidf_tpu.engine.index.ShardIndex` re-lays-out
+the whole corpus on every commit — fine for static corpora, O(corpus) per
+commit for streaming ingest (BASELINE config 4, MS MARCO 8.8M passages).
+This module mirrors how Lucene actually handles that
+(``Worker.java:88,138`` commits append new segment files):
+
+* a **Segment** is an immutable blocked-ELL slice of the corpus built once
+  from the docs added since the previous commit — commit cost is O(new);
+* **deletes/upserts** tombstone the old doc in its segment (a device-side
+  live mask) without touching its postings — exactly Lucene's deleted-docs
+  bitmap. Like Lucene, a tombstoned doc still counts in df until merge;
+* **compaction** merges all segments into one when the segment count
+  exceeds ``max_segments`` (a simple TieredMergePolicy stand-in),
+  reclaiming tombstones and re-tightening df;
+* queries score EVERY segment with the **current** global statistics
+  (df summed over segments, live doc count, live avgdl) — weights are
+  computed in-kernel (:func:`tfidf_tpu.ops.ell.score_segment_ell`), the
+  way Lucene reads collectionStatistics at query time, so IDF never goes
+  stale as the corpus grows.
+
+Global doc ids are (segment base + local id); the searcher maps ids back
+to names via each segment's name table.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfidf_tpu.engine.index import DocEntry
+from tfidf_tpu.models.base import ScoringModel
+from tfidf_tpu.ops.csr import CooShard, next_capacity
+from tfidf_tpu.ops.ell import build_ell_from_coo, cosine_norms_host
+from tfidf_tpu.utils.logging import get_logger
+from tfidf_tpu.utils.metrics import global_metrics
+
+log = get_logger("engine.segments")
+
+
+@dataclass
+class Segment:
+    """Immutable device-resident postings for one commit's new docs."""
+    tfs: tuple            # tuple of f32 [rows_cap_i, width_i]
+    terms: tuple          # tuple of i32 [rows_cap_i, width_i]
+    dls: tuple            # tuple of f32 [rows_cap_i] (model-transformed)
+    norms: tuple          # tuple of f32 [rows_cap_i] (zeros unless cosine)
+    block_live: jax.Array # i32 [n_blocks]
+    live_mask: jax.Array  # f32 [doc_cap] — tombstones are 0
+    doc_cap: int
+    names: list[str]      # local id -> name
+    df: np.ndarray        # f32 [vocab_cap_at_build] — segment's df (host)
+    raw_len: np.ndarray   # f32 [n_docs] — analyzed lengths (host)
+    host_docs: list[DocEntry]   # source postings (compaction + checkpoint)
+    live: np.ndarray = field(default=None)  # bool [n_docs] host mirror
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.names)
+
+
+@dataclass
+class SegmentedSnapshot:
+    """What queries score against: the committed segment list + stats."""
+    segments: list[Segment]
+    df: jax.Array         # f32 [vocab_cap] — summed over segments
+    n_docs: jax.Array     # f32 scalar — LIVE docs
+    avgdl: jax.Array      # f32 scalar — over live docs
+    num_docs: jax.Array   # i32 scalar (total caps, for topk masking)
+    version: int = 0
+    nnz: int = 0
+
+    # searcher compatibility surface
+    @property
+    def doc_names(self) -> list[str]:
+        cached = getattr(self, "_doc_names", None)
+        if cached is None:
+            cached = []
+            for seg in self.segments:
+                cached.extend(seg.names)
+            object.__setattr__(self, "_doc_names", cached)
+        return cached
+
+    @property
+    def padded_names(self) -> list:
+        """Names aligned to the concatenated padded doc-id space (None at
+        pad slots); cached — segments are immutable once committed."""
+        cached = getattr(self, "_padded_names", None)
+        if cached is None:
+            cached = []
+            for seg in self.segments:
+                cached.extend(seg.names)
+                cached.extend([None] * (seg.doc_cap - seg.n_docs))
+            object.__setattr__(self, "_padded_names", cached)
+        return cached
+
+    @property
+    def bases(self) -> list[int]:
+        bases, acc = [], 0
+        for seg in self.segments:
+            bases.append(acc)
+            acc += seg.doc_cap
+        return bases
+
+    def name_of(self, gid: int) -> str | None:
+        for base, seg in zip(self.bases, self.segments):
+            if base <= gid < base + seg.doc_cap:
+                local = gid - base
+                if local < seg.n_docs:
+                    return seg.names[local]
+                return None
+        return None
+
+
+class SegmentedIndex:
+    """Streaming shard index with the same write API as ShardIndex."""
+
+    def __init__(self, model: ScoringModel,
+                 min_nnz_cap: int = 1 << 16,     # unused; API compat
+                 min_doc_cap: int = 1024,
+                 layout: str = "ell",            # segments are always ELL
+                 ell_width_cap: int = 256,
+                 max_segments: int = 8) -> None:
+        if model.needs_norms:
+            # cosine norms depend on global df, which changes every
+            # commit; per-segment norms would go stale (unlike BM25/TFIDF
+            # weights, which are computed per query from current stats)
+            raise NotImplementedError(
+                "tfidf_cosine requires index_mode='rebuild' — segment "
+                "norms cannot track the moving global df")
+        self.model = model
+        self.min_doc_cap = min_doc_cap
+        self.ell_width_cap = ell_width_cap
+        self.max_segments = max_segments
+        self._write_lock = threading.Lock()
+        self._pending: list[DocEntry] = []
+        self._segments: list[Segment] = []
+        # name -> (segment idx | -1 for pending, local idx)
+        self._where: dict[str, tuple[int, int]] = {}
+        self._gen = 1
+        self._committed_gen = 0
+        self._version = 0
+        self.snapshot: SegmentedSnapshot | None = None
+
+    # ---- write path ----
+
+    def add_document(self, name: str, id_counts: dict[int, int],
+                     length: float | None = None) -> None:
+        if id_counts:
+            items = sorted(id_counts.items())
+            ids = np.fromiter((t for t, _ in items), np.int32, len(items))
+            tfs = np.fromiter((f for _, f in items), np.float32,
+                              len(items))
+        else:
+            ids = np.empty(0, np.int32)
+            tfs = np.empty(0, np.float32)
+        self.add_document_arrays(name, ids, tfs, length)
+
+    def add_document_arrays(self, name: str, ids: np.ndarray,
+                            tfs: np.ndarray,
+                            length: float | None = None) -> None:
+        tfs = np.asarray(tfs, np.float32)
+        entry = DocEntry(
+            name=name, term_ids=np.asarray(ids, np.int32), tfs=tfs,
+            length=float(length if length is not None else tfs.sum()))
+        with self._write_lock:
+            self._tombstone_locked(name)
+            self._where[name] = (-1, len(self._pending))
+            self._pending.append(entry)
+            self._gen += 1
+        global_metrics.inc("docs_indexed")
+
+    def delete_document(self, name: str) -> bool:
+        with self._write_lock:
+            ok = self._tombstone_locked(name)
+            if ok:
+                self._where.pop(name, None)
+                self._gen += 1
+            return ok
+
+    def _tombstone_locked(self, name: str) -> bool:
+        loc = self._where.get(name)
+        if loc is None:
+            return False
+        seg_i, local = loc
+        if seg_i == -1:
+            self._pending[local].live = False
+        else:
+            seg = self._segments[seg_i]
+            seg.live[local] = False
+            # device mask updated at next commit (committed searches keep
+            # seeing the pre-delete snapshot, like an uncommitted Lucene
+            # delete)
+        return True
+
+    # ---- stats ----
+
+    @property
+    def num_live_docs(self) -> int:
+        return len(self._where)
+
+    @property
+    def nnz_live(self) -> int:
+        n = sum(d.term_ids.shape[0] for d in self._pending if d.live)
+        for seg in self._segments:
+            n += sum(d.term_ids.shape[0]
+                     for d, alive in zip(seg.host_docs, seg.live) if alive)
+        return int(n)
+
+    def size_bytes(self) -> int:
+        n = sum(d.term_ids.nbytes + d.tfs.nbytes
+                for d in self._pending if d.live)
+        for seg in self._segments:
+            n += sum(d.term_ids.nbytes + d.tfs.nbytes
+                     for d, alive in zip(seg.host_docs, seg.live) if alive)
+        return int(n)
+
+    def live_entries(self) -> list[DocEntry]:
+        with self._write_lock:
+            out = []
+            for seg in self._segments:
+                out.extend(d for d, alive in zip(seg.host_docs, seg.live)
+                           if alive)
+            out.extend(d for d in self._pending if d.live)
+            return out
+
+    # ---- commit ----
+
+    def _build_segment(self, entries: list[DocEntry],
+                       vocab_cap: int) -> Segment:
+        order = np.argsort([-d.term_ids.shape[0] for d in entries],
+                           kind="stable")
+        entries = [entries[i] for i in order]
+        n = len(entries)
+        sizes = np.fromiter((d.term_ids.shape[0] for d in entries),
+                            np.int64, n)
+        nnz = int(sizes.sum())
+        nnz_cap = next_capacity(max(nnz, 1), 1 << 10)
+        doc_cap = next_capacity(max(n, 1), self.min_doc_cap)
+        tf = np.zeros(nnz_cap, np.float32)
+        term = np.zeros(nnz_cap, np.int32)
+        doc = np.full(nnz_cap, doc_cap - 1, np.int32)
+        if nnz:
+            tf[:nnz] = np.concatenate([d.tfs for d in entries])
+            term[:nnz] = np.concatenate([d.term_ids for d in entries])
+            doc[:nnz] = np.repeat(np.arange(n, dtype=np.int32), sizes)
+        df = (np.bincount(term[:nnz], minlength=vocab_cap)[:vocab_cap]
+              .astype(np.float32) if nnz
+              else np.zeros(vocab_cap, np.float32))
+        raw_len = np.fromiter((d.length for d in entries), np.float32, n)
+        doc_len = np.zeros(doc_cap, np.float32)
+        doc_len[:n] = self.model.transform_doc_len(raw_len)
+        coo = CooShard(tf=tf, term=term, doc=doc, doc_len=doc_len, df=df,
+                       nnz=nnz, num_docs=n)
+        if self.model.needs_norms:
+            norms_host = cosine_norms_host(coo, float(max(n, 1)))
+        else:
+            norms_host = np.zeros(doc_cap, np.float32)
+        ell = build_ell_from_coo(coo, width_cap=self.ell_width_cap,
+                                 min_rows=min(256, self.min_doc_cap))
+        # streaming segments keep raw tf on device (weights are computed
+        # per-query with current stats); spill entries are folded into an
+        # extra width-cap block row set — rare, so res goes to blocks too
+        tfs_d, terms_d, dls_d, norms_d, live = [], [], [], [], []
+        for blk in ell.blocks:
+            rows_cap = blk.tf.shape[0]
+            dl_blk = np.zeros(rows_cap, np.float32)
+            dl_blk[:blk.n_rows] = doc_len[blk.row0:blk.row0 + blk.n_rows]
+            nrm_blk = np.zeros(rows_cap, np.float32)
+            nrm_blk[:blk.n_rows] = norms_host[
+                blk.row0:blk.row0 + blk.n_rows]
+            tfs_d.append(jnp.asarray(blk.tf))
+            terms_d.append(jnp.asarray(blk.term))
+            dls_d.append(jnp.asarray(dl_blk))
+            norms_d.append(jnp.asarray(nrm_blk))
+            live.append(blk.n_rows)
+        if ell.res_nnz:
+            raise NotImplementedError(
+                f"document with more than {self.ell_width_cap} distinct "
+                "terms in streaming mode; raise ell_width_cap")
+        return Segment(
+            tfs=tuple(tfs_d), terms=tuple(terms_d), dls=tuple(dls_d),
+            norms=tuple(norms_d),
+            block_live=jnp.asarray(np.asarray(live, np.int32)),
+            live_mask=jnp.ones(doc_cap, jnp.float32)
+            if n == doc_cap else jnp.asarray(
+                (np.arange(doc_cap) < n).astype(np.float32)),
+            doc_cap=doc_cap, names=[d.name for d in entries],
+            df=df, raw_len=raw_len, host_docs=entries,
+            live=np.ones(n, bool))
+
+    def _refresh_live_masks_locked(self) -> None:
+        for seg in self._segments:
+            mask = np.zeros(seg.doc_cap, np.float32)
+            mask[:seg.n_docs] = seg.live.astype(np.float32)
+            seg.live_mask = jnp.asarray(mask)
+
+    def commit(self, vocab_cap: int) -> SegmentedSnapshot:
+        with self._write_lock:
+            gen0 = self._gen
+            if (self._committed_gen == gen0 and self.snapshot is not None
+                    and self.snapshot.df.shape[0] == vocab_cap):
+                return self.snapshot
+            pending = [d for d in self._pending if d.live]
+            self._pending = []
+            if pending:
+                seg = self._build_segment(pending, vocab_cap)
+                # re-point pending docs at their committed location
+                for local, d in enumerate(seg.host_docs):
+                    self._where[d.name] = (len(self._segments), local)
+                self._segments.append(seg)
+            if len(self._segments) > self.max_segments:
+                self._compact_locked(vocab_cap)
+            self._refresh_live_masks_locked()
+            segments = list(self._segments)
+
+            # Global stats over the CURRENT segment set. Both df and the
+            # doc count/avgdl INCLUDE tombstoned docs until compaction —
+            # Lucene's docFreq and docCount move together the same way;
+            # mixing tombstone-inclusive df with live-only N would push
+            # idf negative for heavily-deleted terms.
+            df_total = np.zeros(vocab_cap, np.float32)
+            total_count = 0
+            total_len = 0.0
+            live_count = 0
+            for seg in segments:
+                v = min(len(seg.df), vocab_cap)
+                df_total[:v] += seg.df[:v]
+                total_count += seg.n_docs
+                total_len += float(seg.raw_len.sum())
+                live_count += int(seg.live.sum())
+            self._version += 1
+            snap = SegmentedSnapshot(
+                segments=segments,
+                df=jnp.asarray(df_total),
+                n_docs=jnp.float32(total_count),
+                avgdl=jnp.float32(
+                    total_len / total_count if total_count else 1.0),
+                num_docs=jnp.int32(sum(s.doc_cap for s in segments)),
+                version=self._version,
+                nnz=self.nnz_live)
+            self.snapshot = snap
+            # only as clean as the generation the snapshot was built from,
+            # and only once it is actually published (ShardIndex.commit
+            # maintains the same ordering for the same reason)
+            self._committed_gen = gen0
+        global_metrics.set_gauge("index_segments", len(segments))
+        global_metrics.set_gauge("index_docs", live_count)
+        log.info("committed segment snapshot", version=self._version,
+                 segments=len(segments), docs=live_count)
+        return snap
+
+    def _compact_locked(self, vocab_cap: int) -> None:
+        """Merge all segments into one, dropping tombstones (the merge
+        policy: simple full compaction when over max_segments)."""
+        entries: list[DocEntry] = []
+        for seg in self._segments:
+            entries.extend(d for d, alive in zip(seg.host_docs, seg.live)
+                           if alive)
+        self._segments = []
+        if entries:
+            seg = self._build_segment(entries, vocab_cap)
+            for local, d in enumerate(seg.host_docs):
+                self._where[d.name] = (0, local)
+            self._segments = [seg]
+        global_metrics.inc("compactions")
+        log.info("compacted segments", docs=len(entries))
+
+    def doc_name(self, gid: int) -> str:
+        assert self.snapshot is not None
+        name = self.snapshot.name_of(int(gid))
+        assert name is not None, gid
+        return name

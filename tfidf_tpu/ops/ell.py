@@ -117,6 +117,21 @@ def build_ell_from_coo(coo: CooShard,
                     res_doc=res_doc, res_nnz=res_nnz)
 
 
+def _entry_weights(model: str, tf, df_t, dl_col, n_docs, avgdl,
+                   norms_col, k1: float, b: float):
+    """Per-entry model weights for a [rows, width] block (dl_col/norms_col
+    broadcast as [rows, 1]) — the single dispatch shared by the
+    precomputed-impact and query-time paths."""
+    if model == "bm25":
+        return bm25_weights(tf, df_t, dl_col, n_docs, avgdl, k1=k1, b=b)
+    if model == "tfidf":
+        return tfidf_weights(tf, df_t, n_docs)
+    if model == "tfidf_cosine":
+        w = tfidf_weights(tf, df_t, n_docs)
+        return w / jnp.where(norms_col > 0, norms_col, 1.0)
+    raise ValueError(f"unknown model {model!r}")
+
+
 def ell_impacts(tf: jax.Array,        # f32 [rows, width]
                 term: jax.Array,      # i32 [rows, width]
                 doc_len: jax.Array,   # f32 [rows] (this block's rows)
@@ -128,21 +143,24 @@ def ell_impacts(tf: jax.Array,        # f32 [rows, width]
     """Per-entry impact weights [rows, width] — everything about the score
     that does not depend on the query, precomputed once per commit
     (Lucene's "impacts" idea). The query path is then pure gather+contract."""
-    df_t = df[term]
-    if model == "bm25":
-        return bm25_weights(tf, df_t, doc_len[:, None], n_docs, avgdl,
-                            k1=k1, b=b)
-    if model == "tfidf":
-        return tfidf_weights(tf, df_t, n_docs)
-    if model == "tfidf_cosine":
-        w = tfidf_weights(tf, df_t, n_docs)
-        norm = doc_norms[:, None]
-        return w / jnp.where(norm > 0, norm, 1.0)
-    raise ValueError(f"unknown model {model!r}")
+    norms_col = None if doc_norms is None else doc_norms[:, None]
+    return _entry_weights(model, tf, df[term], doc_len[:, None],
+                          n_docs, avgdl, norms_col, k1, b)
 
 
 # one executable per (block shape, model): commit-time impact precompute
 ell_impacts = jax.jit(ell_impacts, static_argnames=("model", "k1", "b"))
+
+
+def _pick_chunk(rows_cap: int, width: int, B: int, doc_chunk: int) -> int:
+    """Row-chunk bounding the [Dc, W, B] gathered intermediate to ~32MB
+    whatever the batch/width, shrunk to a divisor of rows_cap (power-of-two
+    caps make that a no-op, but nothing forces callers to configure so)."""
+    budget = max(64, (1 << 23) // max(1, width * B))
+    chunk = min(doc_chunk, rows_cap, budget)
+    while rows_cap % chunk:
+        chunk -= 1
+    return chunk
 
 
 def _score_block(impact: jax.Array, term: jax.Array,
@@ -155,13 +173,7 @@ def _score_block(impact: jax.Array, term: jax.Array,
     """
     rows_cap, width = impact.shape
     B = qc_t.shape[1]
-    # bound the [Dc, W, B] gathered intermediate to ~32MB whatever the
-    # batch/width; then shrink to a divisor of rows_cap (power-of-two caps
-    # make this a no-op, but nothing forces callers to configure them so)
-    budget = max(64, (1 << 23) // max(1, width * B))
-    chunk = min(doc_chunk, rows_cap, budget)
-    while rows_cap % chunk:
-        chunk -= 1
+    chunk = _pick_chunk(rows_cap, width, B, doc_chunk)
     n_chunks = rows_cap // chunk
 
     def body(_, xs):
@@ -175,6 +187,34 @@ def _score_block(impact: jax.Array, term: jax.Array,
           term.reshape(n_chunks, chunk, width))
     _, chunks = jax.lax.scan(body, None, xs)          # [n, B, Dc]
     return jnp.moveaxis(chunks, 0, 1).reshape(B, rows_cap)
+
+
+def _rearrange_to_real(parts, block_caps, block_live, doc_cap: int,
+                       B: int) -> jax.Array:
+    """Concatenate per-block padded scores and gather them into the real
+    doc-id space [B, doc_cap].
+
+    Real doc id d lives in block i at padded index pad0_i + (d - row0_i),
+    where row0_i is the sum of (traced) live counts before block i; dead
+    real rows gather from an explicit zero column at index P.
+    """
+    if not parts:
+        return jnp.zeros((B, doc_cap), jnp.float32)
+    padded = jnp.concatenate(
+        parts + [jnp.zeros((B, 1), jnp.float32)], axis=1)   # [B, P+1]
+    P = padded.shape[1] - 1
+    real = jnp.arange(doc_cap, dtype=jnp.int32)
+    row0 = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32),
+         jnp.cumsum(block_live.astype(jnp.int32))])
+    padded_of_real = jnp.full((doc_cap,), P, jnp.int32)
+    pad0 = 0
+    for i, cap in enumerate(block_caps):
+        in_block = (real >= row0[i]) & (real < row0[i + 1])
+        padded_of_real = jnp.where(
+            in_block, pad0 + real - row0[i], padded_of_real)
+        pad0 += cap
+    return padded[:, padded_of_real]                  # [B, doc_cap]
 
 
 def score_ell_impl(impacts,            # tuple of f32 [rows_cap_i, width_i]
@@ -197,27 +237,8 @@ def score_ell_impl(impacts,            # tuple of f32 [rows_cap_i, width_i]
     qc_t = qc_ext.T                                   # [U_cap+1, B]
     parts = [_score_block(imp, term, slot_of, qc_t, doc_chunk)
              for imp, term in zip(impacts, terms)]
-    if not parts:
-        return jnp.zeros((B, doc_cap), jnp.float32)
-    # one explicit zero column at index P: dead real rows gather from it
-    padded = jnp.concatenate(
-        parts + [jnp.zeros((B, 1), jnp.float32)], axis=1)   # [B, P+1]
-    P = padded.shape[1] - 1
-
-    # real doc id d lives in block i at padded index pad0_i + (d - row0_i),
-    # where row0_i = sum of live counts before block i (traced)
-    real = jnp.arange(doc_cap, dtype=jnp.int32)
-    row0 = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32),
-         jnp.cumsum(block_live.astype(jnp.int32))])
-    padded_of_real = jnp.full((doc_cap,), P, jnp.int32)
-    pad0 = 0
-    for i, imp in enumerate(impacts):
-        in_block = (real >= row0[i]) & (real < row0[i + 1])
-        padded_of_real = jnp.where(
-            in_block, pad0 + real - row0[i], padded_of_real)
-        pad0 += imp.shape[0]
-    return padded[:, padded_of_real]                  # [B, doc_cap]
+    return _rearrange_to_real(parts, [imp.shape[0] for imp in impacts],
+                              block_live, doc_cap, B)
 
 
 def score_ell_with_residual(impacts, terms, block_live,
@@ -247,6 +268,84 @@ def score_ell_with_residual(impacts, terms, block_live,
 score_ell_batch = jax.jit(
     score_ell_with_residual,
     static_argnames=("model", "k1", "b", "doc_chunk", "res_chunk"))
+
+
+def _score_block_tf(tf: jax.Array, term: jax.Array, dl: jax.Array,
+                    df: jax.Array, slot_of: jax.Array, qc_t: jax.Array,
+                    n_docs, avgdl, norms, doc_chunk: int,
+                    *, model: str, k1: float, b: float) -> jax.Array:
+    """ELL block scored with weights computed IN-KERNEL from the current
+    global stats (df/N/avgdl) — the streaming-segment path, where
+    precomputed impacts would go stale as the corpus grows. Lucene
+    likewise scores old segments with current collectionStatistics."""
+    rows_cap, width = tf.shape
+    B = qc_t.shape[1]
+    chunk = _pick_chunk(rows_cap, width, B, doc_chunk)
+    n_chunks = rows_cap // chunk
+
+    def body(_, xs):
+        tf_c, term_c, dl_c, nrm_c = xs                # [Dc, W] / [Dc]
+        w = _entry_weights(model, tf_c, df[term_c], dl_c[:, None],
+                           n_docs, avgdl, nrm_c[:, None], k1, b)
+        qg = qc_t[slot_of[term_c]]                    # [Dc, W, B]
+        return None, jnp.einsum("dwb,dw->bd", qg, w,
+                                preferred_element_type=jnp.float32)
+
+    xs = (tf.reshape(n_chunks, chunk, width),
+          term.reshape(n_chunks, chunk, width),
+          dl.reshape(n_chunks, chunk),
+          norms.reshape(n_chunks, chunk))
+    _, chunks = jax.lax.scan(body, None, xs)
+    return jnp.moveaxis(chunks, 0, 1).reshape(B, rows_cap)
+
+
+def score_segment_ell(tfs, terms, dls, norms,   # tuples of block arrays
+                      block_live,               # i32 [n_blocks] (traced)
+                      live_mask,                # f32 [doc_cap] 1=live
+                      df, slot_of, qc_t, n_docs, avgdl,
+                      *, model: str = "bm25", k1: float = 1.2,
+                      b: float = 0.75, doc_chunk: int = 2048) -> jax.Array:
+    """One streaming segment: blocked ELL scored with current stats,
+    rearranged to the segment's real doc space, tombstones zeroed.
+    Returns ``[B, doc_cap]``. ``slot_of``/``qc_t`` come from the caller's
+    single per-batch ``_compile_queries``."""
+    doc_cap = live_mask.shape[0]
+    B = qc_t.shape[1]
+    parts = [_score_block_tf(tf, term, dl, df, slot_of, qc_t,
+                             n_docs, avgdl, nrm, doc_chunk,
+                             model=model, k1=k1, b=b)
+             for tf, term, dl, nrm in zip(tfs, terms, dls, norms)]
+    scores = _rearrange_to_real(parts, [tf.shape[0] for tf in tfs],
+                                block_live, doc_cap, B)
+    return scores * live_mask[None, :]
+
+
+def score_segments_impl(seg_data, df, q: QueryBatch, n_docs, avgdl,
+                        *, model: str = "bm25", k1: float = 1.2,
+                        b: float = 0.75,
+                        doc_chunk: int = 2048) -> jax.Array:
+    """All streaming segments scored + concatenated: ``[B, sum(doc_cap)]``.
+
+    ``seg_data`` is a tuple of per-segment
+    ``(tfs, terms, dls, norms, block_live, live_mask)`` pytrees; the jit
+    cache keys on the (static) segment shape structure, so repeated
+    queries against the same segment set reuse one executable.
+    """
+    B = q.slots.shape[0]
+    if not seg_data:
+        return jnp.zeros((B, 0), jnp.float32)
+    slot_of, qc_ext = _compile_queries(q, df.shape[0])
+    qc_t = qc_ext.T
+    outs = [score_segment_ell(*sd, df, slot_of, qc_t, n_docs, avgdl,
+                              model=model, k1=k1, b=b,
+                              doc_chunk=doc_chunk)
+            for sd in seg_data]
+    return jnp.concatenate(outs, axis=1)
+
+
+score_segments_batch = jax.jit(
+    score_segments_impl,
+    static_argnames=("model", "k1", "b", "doc_chunk"))
 
 
 def cosine_norms_host(coo: CooShard, n_docs: float) -> np.ndarray:

@@ -70,6 +70,13 @@ class Config:
     scoring_layout: str = "ell"
     ell_width_cap: int = 256   # max ELL row width; longer docs spill to COO
 
+    # --- index mode ---
+    # "rebuild": every commit re-lays-out the whole corpus (static corpora)
+    # "segments": Lucene-style streaming segments — commit is O(new docs),
+    #             tombstone deletes, compaction above max_segments
+    index_mode: str = "rebuild"
+    max_segments: int = 8
+
     # --- ingest ---
     # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back
     # to the pure-Python analyzer when no compiler is available or for

@@ -165,3 +165,122 @@ class TestCheckpointStreaming:
         for q in QUERIES:
             assert ([h.name for h in e.search(q)]
                     == [h.name for h in e2.search(q)])
+
+
+class TestWideDocSpill:
+    """Docs with more distinct terms than ell_width_cap must stream via
+    the per-segment COO residual (VERDICT r1 #3; Worker.java:190-220
+    indexes arbitrarily wide docs)."""
+
+    def _wide_text(self, n_terms: int) -> str:
+        # n_terms distinct tokens, some repeated for non-trivial tf
+        words = [f"term{i:04d}" for i in range(n_terms)]
+        return " ".join(words) + " " + " ".join(words[:7])
+
+    def test_wide_doc_matches_rebuild(self, tmp_path):
+        # width cap 16 -> a 1000-distinct-term doc spills heavily
+        seg = make_engine(tmp_path, "wseg", "segments", ell_width_cap=16)
+        reb = make_engine(tmp_path, "wreb", "rebuild", ell_width_cap=16)
+        wide = self._wide_text(1000)
+        for e in (seg, reb):
+            for name, text in TEXTS.items():
+                e.ingest_text(name, text)
+            e.ingest_text("wide.txt", wide)
+            e.commit()
+        qs = QUERIES + ("term0003 term0500 fox",)
+        assert results(seg, qs) == results(reb, qs)
+        # the wide doc itself must be findable through the residual
+        hits = seg.search("term0999")
+        assert [h.name for h in hits] == ["wide.txt"]
+
+    def test_failed_or_wide_commit_loses_nothing(self, tmp_path):
+        # commit with a wide doc + normal docs: all docs survive, and a
+        # subsequent upsert/delete of any of them works (regression for
+        # the r1 clear-before-build bug)
+        e = make_engine(tmp_path, "wlose", "segments", ell_width_cap=16)
+        e.ingest_text("wide.txt", self._wide_text(300))
+        e.ingest_text("ok.txt", "plain small document")
+        e.commit()
+        assert e.index.num_live_docs == 2
+        e.ingest_text("ok.txt", "updated small document")
+        assert e.delete("wide.txt")
+        e.commit()
+        assert [h.name for h in e.search("updated")] == ["ok.txt"]
+        assert e.search("term0299") == []
+
+    def test_wide_doc_across_compaction(self, tmp_path):
+        e = make_engine(tmp_path, "wcomp", "segments", ell_width_cap=16,
+                        max_segments=1)
+        e.ingest_text("wide.txt", self._wide_text(200))
+        e.commit()
+        e.ingest_text("x.txt", "xylophone")
+        e.commit()   # compaction re-lays-out the wide doc
+        assert [h.name for h in e.search("term0150")] == ["wide.txt"]
+        assert [h.name for h in e.search("xylophone")] == ["x.txt"]
+
+
+class TestCosineStreaming:
+    """tfidf_cosine in segments mode: norms recomputed at commit from the
+    current global df (VERDICT r1 weak #5)."""
+
+    def test_cosine_matches_rebuild(self, tmp_path):
+        seg = make_engine(tmp_path, "cseg", "segments",
+                          model="tfidf_cosine")
+        reb = make_engine(tmp_path, "creb", "rebuild",
+                          model="tfidf_cosine")
+        items = list(TEXTS.items())
+        for i in range(0, len(items), 2):
+            for name, text in items[i:i + 2]:
+                seg.ingest_text(name, text)
+            seg.commit()   # norms must track df across 3 commits
+        for name, text in items:
+            reb.ingest_text(name, text)
+        reb.commit()
+        assert results(seg) == results(reb)
+
+    def test_cosine_norms_refresh_after_growth(self, tmp_path):
+        e = make_engine(tmp_path, "cgrow", "segments",
+                        model="tfidf_cosine")
+        e.ingest_text("a.txt", "shared unique")
+        e.commit()
+        s1 = {h.name: h.score for h in e.search("shared")}
+        # adding docs containing "shared" changes its df -> a.txt's norm
+        # and score must change (stale per-segment norms would not)
+        for i in range(4):
+            e.ingest_text(f"x{i}.txt", "shared filler words here")
+        e.commit()
+        s2 = {h.name: h.score for h in e.search("shared")}
+        assert abs(s1["a.txt"] - s2["a.txt"]) > 1e-6
+
+    def test_cosine_wide_doc_spill(self, tmp_path):
+        seg = make_engine(tmp_path, "cwide", "segments",
+                          model="tfidf_cosine", ell_width_cap=16)
+        reb = make_engine(tmp_path, "cwreb", "rebuild",
+                          model="tfidf_cosine", ell_width_cap=16)
+        wide = " ".join(f"w{i:03d}" for i in range(100))
+        for e in (seg, reb):
+            e.ingest_text("wide.txt", wide)
+            e.ingest_text("a.txt", "w001 w002 and more")
+            e.commit()
+        qs = ("w001", "w050 w099")
+        assert results(seg, qs) == results(reb, qs)
+
+
+class TestSnapshotIsolation:
+    def test_published_snapshot_ignores_later_deletes(self, tmp_path):
+        """ADVICE r1: deletes must not flip the live mask of an
+        already-published snapshot (its masks are snapshot-owned)."""
+        import numpy as np
+        e = make_engine(tmp_path, "iso", "segments")
+        for name, text in list(TEXTS.items())[:3]:
+            e.ingest_text(name, text)
+        e.commit()
+        snap1 = e.index.snapshot
+        mask1 = np.asarray(snap1.views[0].live_mask).copy()
+        e.delete("a.txt")
+        e.commit()
+        snap2 = e.index.snapshot
+        # old snapshot's mask unchanged; new snapshot sees the delete
+        assert (np.asarray(snap1.views[0].live_mask) == mask1).all()
+        assert (np.asarray(snap2.views[0].live_mask).sum()
+                == mask1.sum() - 1)

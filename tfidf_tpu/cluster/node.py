@@ -361,7 +361,7 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     # reference returns [] on any failure (Worker.java:183)
                     log.warning("search failed", err=repr(e))
                     hits = []
-                global_metrics.inc("queries_served")
+                # queries_served is counted once, by Searcher.search
                 self._json([{"document": {"name": h.name}, "score": h.score}
                             for h in hits])
             elif u.path == "/worker/upload":
@@ -370,9 +370,9 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._text("missing file name", 400)
                     return
                 global_injector.check("worker.upload")
+                # docs_indexed is counted once, by the index add path
                 node.engine.ingest_bytes(name, data, save_to_disk=True)
                 node.engine.commit()
-                global_metrics.inc("docs_indexed")
                 self._text(f"File {name} uploaded and indexed")
             elif u.path == "/leader/start":
                 query = self._read_query()

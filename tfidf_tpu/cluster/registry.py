@@ -15,7 +15,6 @@ can find the coordinator.
 from __future__ import annotations
 
 import threading
-import time
 
 from tfidf_tpu.cluster.coordination import (EPHEMERAL, EPHEMERAL_SEQUENTIAL,
                                             Event, NodeExistsError,
@@ -90,20 +89,27 @@ class ServiceRegistry:
     # ``process(WatchedEvent)`` (:113-122). The one-shot watch was consumed
     # when this fired, so a failed refresh MUST be retried — otherwise the
     # membership cache freezes forever on a transient coordination hiccup.
+    # Retries never sleep on the shared watch-dispatch thread: a slow
+    # refresh here would delay every other client event, including the
+    # election NodeDeleted that failover latency depends on.
     def _on_change(self, ev: Event) -> None:
-        for delay in (0.0, 0.1, 0.5, 1.0):
-            if delay:
-                time.sleep(delay)
-            try:
-                self._update_addresses()
-                return
-            except Exception as e:
-                log.warning("membership refresh failed, retrying",
-                            err=repr(e))
-        # keep trying off the dispatch thread so other events still flow
-        t = threading.Timer(5.0, self._on_change, args=(ev,))
+        try:
+            self._update_addresses()
+        except Exception as e:
+            log.warning("membership refresh failed, retrying", err=repr(e))
+            self._schedule_retry(0.1)
+
+    def _schedule_retry(self, delay: float) -> None:
+        t = threading.Timer(delay, self._retry, args=(delay,))
         t.daemon = True
         t.start()
+
+    def _retry(self, delay: float) -> None:
+        try:
+            self._update_addresses()
+        except Exception as e:
+            log.warning("membership refresh failed, retrying", err=repr(e))
+            self._schedule_retry(min(delay * 2, 5.0))
 
 
 def publish_leader_info(coord, address: str) -> None:

@@ -110,11 +110,8 @@ class Searcher:
                 batch_cap=cap, max_terms=self.max_query_terms)
         with trace_phase("score"):
             if isinstance(snap, SegmentedSnapshot):
-                seg_data = tuple(
-                    (s.tfs, s.terms, s.dls, s.norms, s.block_live,
-                     s.live_mask) for s in snap.segments)
                 scores = score_segments_batch(
-                    seg_data, snap.df, qb, snap.n_docs, snap.avgdl,
+                    snap.views, snap.df, qb, snap.n_docs, snap.avgdl,
                     **self.model.score_kwargs())
             elif snap.is_ell:
                 # gather/MXU fast path: impacts precomputed at commit

@@ -8,9 +8,14 @@ This module mirrors how Lucene actually handles that
 
 * a **Segment** is an immutable blocked-ELL slice of the corpus built once
   from the docs added since the previous commit — commit cost is O(new);
-* **deletes/upserts** tombstone the old doc in its segment (a device-side
-  live mask) without touching its postings — exactly Lucene's deleted-docs
-  bitmap. Like Lucene, a tombstoned doc still counts in df until merge;
+  documents wider than ``ell_width_cap`` spill their extra postings into a
+  per-segment COO residual (Lucene indexes arbitrarily wide docs,
+  ``Worker.java:190-220``; so does streaming mode);
+* **deletes/upserts** tombstone the old doc in its segment without touching
+  its postings — exactly Lucene's deleted-docs bitmap. Like Lucene, a
+  tombstoned doc still counts in df until merge. The device live mask is
+  owned by the published *snapshot*, not the shared Segment, so searches
+  against an old snapshot never observe later deletes mid-batch;
 * **compaction** merges all segments into one when the segment count
   exceeds ``max_segments`` (a simple TieredMergePolicy stand-in),
   reclaiming tombstones and re-tightening df;
@@ -18,7 +23,10 @@ This module mirrors how Lucene actually handles that
   (df summed over segments, live doc count, live avgdl) — weights are
   computed in-kernel (:func:`tfidf_tpu.ops.ell.score_segment_ell`), the
   way Lucene reads collectionStatistics at query time, so IDF never goes
-  stale as the corpus grows.
+  stale as the corpus grows. For ``tfidf_cosine``, per-document norms
+  depend on the moving global df, so they are recomputed at commit from
+  the retained host postings — an O(corpus) host pass that only the
+  cosine model pays.
 
 Global doc ids are (segment base + local id); the searcher maps ids back
 to names via each segment's name table.
@@ -36,7 +44,7 @@ import numpy as np
 from tfidf_tpu.engine.index import DocEntry
 from tfidf_tpu.models.base import ScoringModel
 from tfidf_tpu.ops.csr import CooShard, next_capacity
-from tfidf_tpu.ops.ell import build_ell_from_coo, cosine_norms_host
+from tfidf_tpu.ops.ell import SegmentView, build_ell_from_coo
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
 
@@ -49,14 +57,20 @@ class Segment:
     tfs: tuple            # tuple of f32 [rows_cap_i, width_i]
     terms: tuple          # tuple of i32 [rows_cap_i, width_i]
     dls: tuple            # tuple of f32 [rows_cap_i] (model-transformed)
-    norms: tuple          # tuple of f32 [rows_cap_i] (zeros unless cosine)
+    norms0: tuple         # tuple of f32 [rows_cap_i] zeros (non-cosine)
     block_live: jax.Array # i32 [n_blocks]
-    live_mask: jax.Array  # f32 [doc_cap] — tombstones are 0
+    block_rows: tuple     # host n_rows per block (for norm scatter)
+    block_caps: tuple     # host rows_cap per block
     doc_cap: int
     names: list[str]      # local id -> name
     df: np.ndarray        # f32 [vocab_cap_at_build] — segment's df (host)
     raw_len: np.ndarray   # f32 [n_docs] — analyzed lengths (host)
     host_docs: list[DocEntry]   # source postings (compaction + checkpoint)
+    # COO residual for rows wider than ell_width_cap (None: no spill)
+    res_tf: jax.Array | None
+    res_term: jax.Array | None
+    res_doc: jax.Array | None
+    doc_len_d: jax.Array | None  # f32 [doc_cap] transformed (residual path)
     live: np.ndarray = field(default=None)  # bool [n_docs] host mirror
 
     @property
@@ -66,11 +80,17 @@ class Segment:
 
 @dataclass
 class SegmentedSnapshot:
-    """What queries score against: the committed segment list + stats."""
+    """What queries score against: the committed segment list + stats.
+
+    ``views`` are the scoring-ready pytrees; per-commit state (live masks,
+    cosine norms) lives here, never on the shared Segment objects, so an
+    in-flight search against an older snapshot keeps its own masks.
+    """
     segments: list[Segment]
+    views: tuple          # tuple of SegmentView, aligned with segments
     df: jax.Array         # f32 [vocab_cap] — summed over segments
-    n_docs: jax.Array     # f32 scalar — LIVE docs
-    avgdl: jax.Array      # f32 scalar — over live docs
+    n_docs: jax.Array     # f32 scalar — total docs incl. tombstones
+    avgdl: jax.Array      # f32 scalar
     num_docs: jax.Array   # i32 scalar (total caps, for topk masking)
     version: int = 0
     nnz: int = 0
@@ -126,13 +146,6 @@ class SegmentedIndex:
                  layout: str = "ell",            # segments are always ELL
                  ell_width_cap: int = 256,
                  max_segments: int = 8) -> None:
-        if model.needs_norms:
-            # cosine norms depend on global df, which changes every
-            # commit; per-segment norms would go stale (unlike BM25/TFIDF
-            # weights, which are computed per query from current stats)
-            raise NotImplementedError(
-                "tfidf_cosine requires index_mode='rebuild' — segment "
-                "norms cannot track the moving global df")
         self.model = model
         self.min_doc_cap = min_doc_cap
         self.ell_width_cap = ell_width_cap
@@ -193,9 +206,10 @@ class SegmentedIndex:
         else:
             seg = self._segments[seg_i]
             seg.live[local] = False
-            # device mask updated at next commit (committed searches keep
-            # seeing the pre-delete snapshot, like an uncommitted Lucene
-            # delete)
+            # the host mirror is the only thing mutated here; device masks
+            # are built per published snapshot at the next commit, so
+            # committed searches keep seeing the pre-delete snapshot (an
+            # uncommitted Lucene delete)
         return True
 
     # ---- stats ----
@@ -257,48 +271,82 @@ class SegmentedIndex:
         doc_len[:n] = self.model.transform_doc_len(raw_len)
         coo = CooShard(tf=tf, term=term, doc=doc, doc_len=doc_len, df=df,
                        nnz=nnz, num_docs=n)
-        if self.model.needs_norms:
-            norms_host = cosine_norms_host(coo, float(max(n, 1)))
-        else:
-            norms_host = np.zeros(doc_cap, np.float32)
         ell = build_ell_from_coo(coo, width_cap=self.ell_width_cap,
                                  min_rows=min(256, self.min_doc_cap))
         # streaming segments keep raw tf on device (weights are computed
-        # per-query with current stats); spill entries are folded into an
-        # extra width-cap block row set — rare, so res goes to blocks too
-        tfs_d, terms_d, dls_d, norms_d, live = [], [], [], [], []
+        # per-query with current stats)
+        tfs_d, terms_d, dls_d, norms0, rows, caps = [], [], [], [], [], []
         for blk in ell.blocks:
             rows_cap = blk.tf.shape[0]
             dl_blk = np.zeros(rows_cap, np.float32)
             dl_blk[:blk.n_rows] = doc_len[blk.row0:blk.row0 + blk.n_rows]
-            nrm_blk = np.zeros(rows_cap, np.float32)
-            nrm_blk[:blk.n_rows] = norms_host[
-                blk.row0:blk.row0 + blk.n_rows]
             tfs_d.append(jnp.asarray(blk.tf))
             terms_d.append(jnp.asarray(blk.term))
             dls_d.append(jnp.asarray(dl_blk))
-            norms_d.append(jnp.asarray(nrm_blk))
-            live.append(blk.n_rows)
+            norms0.append(jnp.zeros(rows_cap, jnp.float32))
+            rows.append(blk.n_rows)
+            caps.append(rows_cap)
         if ell.res_nnz:
-            raise NotImplementedError(
-                f"document with more than {self.ell_width_cap} distinct "
-                "terms in streaming mode; raise ell_width_cap")
+            # over-wide docs: extra postings spill into a per-segment COO
+            # residual, scored by the chunked path with the same
+            # current-stats weights (reusing the rebuild layout's spill
+            # design, ops/ell.py build_ell_from_coo)
+            res_tf = jnp.asarray(ell.res_tf)
+            res_term = jnp.asarray(ell.res_term)
+            res_doc = jnp.asarray(ell.res_doc)
+            doc_len_d = jnp.asarray(doc_len)
+        else:
+            res_tf = res_term = res_doc = doc_len_d = None
         return Segment(
             tfs=tuple(tfs_d), terms=tuple(terms_d), dls=tuple(dls_d),
-            norms=tuple(norms_d),
-            block_live=jnp.asarray(np.asarray(live, np.int32)),
-            live_mask=jnp.ones(doc_cap, jnp.float32)
-            if n == doc_cap else jnp.asarray(
-                (np.arange(doc_cap) < n).astype(np.float32)),
+            norms0=tuple(norms0),
+            block_live=jnp.asarray(np.asarray(rows, np.int32)),
+            block_rows=tuple(rows), block_caps=tuple(caps),
             doc_cap=doc_cap, names=[d.name for d in entries],
             df=df, raw_len=raw_len, host_docs=entries,
+            res_tf=res_tf, res_term=res_term, res_doc=res_doc,
+            doc_len_d=doc_len_d,
             live=np.ones(n, bool))
 
-    def _refresh_live_masks_locked(self) -> None:
-        for seg in self._segments:
-            mask = np.zeros(seg.doc_cap, np.float32)
-            mask[:seg.n_docs] = seg.live.astype(np.float32)
-            seg.live_mask = jnp.asarray(mask)
+    def _cosine_norms_real(self, seg: Segment, df_total: np.ndarray,
+                           n_total: float) -> np.ndarray:
+        """Per-local-doc L2 norms of the TF-IDF vectors under the CURRENT
+        global df — recomputed every commit (host pass over the retained
+        postings; only the cosine model pays this)."""
+        norms = np.zeros(seg.doc_cap, np.float32)
+        for local, d in enumerate(seg.host_docs):
+            if d.term_ids.shape[0]:
+                dft = df_total[d.term_ids]
+                w = d.tfs * (np.log((1.0 + n_total) / (1.0 + dft)) + 1.0)
+                norms[local] = np.sqrt(float((w * w).sum()))
+        return norms
+
+    def _make_view(self, seg: Segment, df_total: np.ndarray,
+                   n_total: float) -> SegmentView:
+        mask = np.zeros(seg.doc_cap, np.float32)
+        mask[:seg.n_docs] = seg.live.astype(np.float32)
+        if self.model.needs_norms:
+            norms_real = self._cosine_norms_real(seg, df_total, n_total)
+            norms_blocks, row0 = [], 0
+            for n_rows, cap in zip(seg.block_rows, seg.block_caps):
+                blk = np.zeros(cap, np.float32)
+                blk[:n_rows] = norms_real[row0:row0 + n_rows]
+                norms_blocks.append(jnp.asarray(blk))
+                row0 += n_rows
+            norms = tuple(norms_blocks)
+            res_norms = (jnp.asarray(norms_real)
+                         if seg.res_tf is not None else None)
+        else:
+            norms = seg.norms0
+            res_norms = None
+        res = None
+        if seg.res_tf is not None:
+            res = (seg.res_tf, seg.res_term, seg.res_doc, seg.doc_len_d,
+                   res_norms)
+        return SegmentView(
+            tfs=seg.tfs, terms=seg.terms, dls=seg.dls, norms=norms,
+            block_live=seg.block_live, live_mask=jnp.asarray(mask),
+            res=res)
 
     def commit(self, vocab_cap: int) -> SegmentedSnapshot:
         with self._write_lock:
@@ -307,16 +355,18 @@ class SegmentedIndex:
                     and self.snapshot.df.shape[0] == vocab_cap):
                 return self.snapshot
             pending = [d for d in self._pending if d.live]
+            # build FIRST; index state is swapped only after the build
+            # succeeds, so a failed build loses nothing and _where never
+            # points at vanished pending slots
+            new_seg = (self._build_segment(pending, vocab_cap)
+                       if pending else None)
             self._pending = []
-            if pending:
-                seg = self._build_segment(pending, vocab_cap)
-                # re-point pending docs at their committed location
-                for local, d in enumerate(seg.host_docs):
+            if new_seg is not None:
+                for local, d in enumerate(new_seg.host_docs):
                     self._where[d.name] = (len(self._segments), local)
-                self._segments.append(seg)
+                self._segments.append(new_seg)
             if len(self._segments) > self.max_segments:
                 self._compact_locked(vocab_cap)
-            self._refresh_live_masks_locked()
             segments = list(self._segments)
 
             # Global stats over the CURRENT segment set. Both df and the
@@ -334,9 +384,13 @@ class SegmentedIndex:
                 total_count += seg.n_docs
                 total_len += float(seg.raw_len.sum())
                 live_count += int(seg.live.sum())
+            views = tuple(self._make_view(seg, df_total,
+                                          float(total_count))
+                          for seg in segments)
             self._version += 1
             snap = SegmentedSnapshot(
                 segments=segments,
+                views=views,
                 df=jnp.asarray(df_total),
                 n_docs=jnp.float32(total_count),
                 avgdl=jnp.float32(

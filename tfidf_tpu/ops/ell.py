@@ -29,6 +29,7 @@ Replaces the posting-list traversal inside Lucene's ``searcher.search``
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -36,8 +37,8 @@ import numpy as np
 
 from tfidf_tpu.ops.csr import CooShard, next_capacity
 from tfidf_tpu.ops.scoring import (QueryBatch, _compile_queries,
-                                   bm25_weights, score_coo_impl,
-                                   tfidf_weights)
+                                   bm25_weights, score_coo_compiled,
+                                   score_coo_impl, tfidf_weights)
 
 
 @dataclass
@@ -299,47 +300,76 @@ def _score_block_tf(tf: jax.Array, term: jax.Array, dl: jax.Array,
     return jnp.moveaxis(chunks, 0, 1).reshape(B, rows_cap)
 
 
-def score_segment_ell(tfs, terms, dls, norms,   # tuples of block arrays
-                      block_live,               # i32 [n_blocks] (traced)
-                      live_mask,                # f32 [doc_cap] 1=live
-                      df, slot_of, qc_t, n_docs, avgdl,
+class SegmentView(NamedTuple):
+    """Scoring-ready pytree for one streaming segment.
+
+    Built at commit time (:meth:`SegmentedIndex.commit`); the snapshot —
+    not the shared Segment object — owns the per-commit pieces
+    (``live_mask``, cosine ``norms``), so an already-published snapshot
+    never observes later deletes or df drift (snapshot isolation, the
+    "fresh DirectoryReader" guarantee of ``Worker.java:223``).
+    """
+    tfs: tuple            # f32 [rows_cap_i, width_i] blocks
+    terms: tuple          # i32 [rows_cap_i, width_i]
+    dls: tuple            # f32 [rows_cap_i] (model-transformed lengths)
+    norms: tuple          # f32 [rows_cap_i] (zeros unless cosine)
+    block_live: jax.Array # i32 [n_blocks] (traced)
+    live_mask: jax.Array  # f32 [doc_cap] — 1=live, tombstones 0
+    # COO residual for rows wider than the ELL width cap (None: no spill):
+    # (res_tf, res_term, res_doc, res_dl [doc_cap], res_norms [doc_cap])
+    res: tuple | None
+
+
+def score_segment_ell(view: SegmentView, df, slot_of, qc_ext, qc_t,
+                      n_docs, avgdl,
                       *, model: str = "bm25", k1: float = 1.2,
                       b: float = 0.75, doc_chunk: int = 2048) -> jax.Array:
     """One streaming segment: blocked ELL scored with current stats,
-    rearranged to the segment's real doc space, tombstones zeroed.
-    Returns ``[B, doc_cap]``. ``slot_of``/``qc_t`` come from the caller's
-    single per-batch ``_compile_queries``."""
-    doc_cap = live_mask.shape[0]
+    rearranged to the segment's real doc space, plus the COO residual for
+    over-wide documents, tombstones zeroed. Returns ``[B, doc_cap]``.
+    ``slot_of``/``qc_ext``/``qc_t`` come from the caller's single
+    per-batch ``_compile_queries``."""
+    doc_cap = view.live_mask.shape[0]
     B = qc_t.shape[1]
     parts = [_score_block_tf(tf, term, dl, df, slot_of, qc_t,
                              n_docs, avgdl, nrm, doc_chunk,
                              model=model, k1=k1, b=b)
-             for tf, term, dl, nrm in zip(tfs, terms, dls, norms)]
-    scores = _rearrange_to_real(parts, [tf.shape[0] for tf in tfs],
-                                block_live, doc_cap, B)
-    return scores * live_mask[None, :]
+             for tf, term, dl, nrm in zip(view.tfs, view.terms,
+                                          view.dls, view.norms)]
+    scores = _rearrange_to_real(parts, [tf.shape[0] for tf in view.tfs],
+                                view.block_live, doc_cap, B)
+    if view.res is not None:
+        # docs with more distinct terms than the width cap spill here —
+        # scored by the chunked scatter path with the same in-kernel
+        # current-stats weights (Lucene indexes arbitrarily wide docs,
+        # Worker.java:190-220; streaming must too)
+        res_tf, res_term, res_doc, res_dl, res_norms = view.res
+        scores = scores + score_coo_compiled(
+            res_tf, res_term, res_doc, res_dl, df, slot_of, qc_ext,
+            n_docs, avgdl, res_norms, model=model, k1=k1, b=b,
+            chunk=min(1 << 10, res_tf.shape[0]))
+    return scores * view.live_mask[None, :]
 
 
-def score_segments_impl(seg_data, df, q: QueryBatch, n_docs, avgdl,
+def score_segments_impl(views, df, q: QueryBatch, n_docs, avgdl,
                         *, model: str = "bm25", k1: float = 1.2,
                         b: float = 0.75,
                         doc_chunk: int = 2048) -> jax.Array:
     """All streaming segments scored + concatenated: ``[B, sum(doc_cap)]``.
 
-    ``seg_data`` is a tuple of per-segment
-    ``(tfs, terms, dls, norms, block_live, live_mask)`` pytrees; the jit
-    cache keys on the (static) segment shape structure, so repeated
-    queries against the same segment set reuse one executable.
+    ``views`` is a tuple of :class:`SegmentView` pytrees; the jit cache
+    keys on the (static) segment shape structure, so repeated queries
+    against the same segment set reuse one executable.
     """
     B = q.slots.shape[0]
-    if not seg_data:
+    if not views:
         return jnp.zeros((B, 0), jnp.float32)
     slot_of, qc_ext = _compile_queries(q, df.shape[0])
     qc_t = qc_ext.T
-    outs = [score_segment_ell(*sd, df, slot_of, qc_t, n_docs, avgdl,
+    outs = [score_segment_ell(v, df, slot_of, qc_ext, qc_t, n_docs, avgdl,
                               model=model, k1=k1, b=b,
                               doc_chunk=doc_chunk)
-            for sd in seg_data]
+            for v in views]
     return jnp.concatenate(outs, axis=1)
 
 

@@ -129,33 +129,31 @@ def _compile_queries(q: QueryBatch,
     return slot_of, qc_ext
 
 
-def score_coo_impl(tf: jax.Array,         # f32 [nnz_cap]
-                    term: jax.Array,      # i32 [nnz_cap]
-                    doc: jax.Array,       # i32 [nnz_cap], row-sorted
-                    doc_len: jax.Array,   # f32 [doc_cap]
-                    df: jax.Array,        # f32 [vocab_cap]
-                    q: QueryBatch,
-                    n_docs: jax.Array,    # f32 scalar (traced: no recompiles)
-                    avgdl: jax.Array,     # f32 scalar
-                    doc_norms: jax.Array | None = None,  # f32 [doc_cap]
-                    *,
-                    model: str = "bm25",
-                    k1: float = 1.2,
-                    b: float = 0.75,
-                    chunk: int = 1 << 17) -> jax.Array:
-    """Score every document in the shard against every query.
-
-    Returns ``scores [B, doc_cap]`` (padded docs score 0; mask in top-k).
-    """
+def score_coo_compiled(tf: jax.Array,     # f32 [nnz_cap]
+                       term: jax.Array,   # i32 [nnz_cap]
+                       doc: jax.Array,    # i32 [nnz_cap], row-sorted
+                       doc_len: jax.Array,   # f32 [doc_cap]
+                       df: jax.Array,        # f32 [vocab_cap]
+                       slot_of: jax.Array,   # i32 [vocab_cap]
+                       qc_ext: jax.Array,    # f32 [B, U_cap+1]
+                       n_docs: jax.Array,    # f32 scalar (traced)
+                       avgdl: jax.Array,     # f32 scalar
+                       doc_norms: jax.Array | None = None,  # f32 [doc_cap]
+                       *,
+                       model: str = "bm25",
+                       k1: float = 1.2,
+                       b: float = 0.75,
+                       chunk: int = 1 << 17) -> jax.Array:
+    """COO scoring against an already-compiled query batch (``slot_of`` /
+    ``qc_ext`` from :func:`_compile_queries`) — lets callers that score
+    several structures per batch (segments + residuals) compile the
+    queries once."""
     nnz_cap = tf.shape[0]
     doc_cap = doc_len.shape[0]
-    vocab_cap = df.shape[0]
     chunk = min(chunk, nnz_cap)
     assert nnz_cap % chunk == 0, (nnz_cap, chunk)
     n_chunks = nnz_cap // chunk
-
-    slot_of, qc_ext = _compile_queries(q, vocab_cap)
-    B = q.slots.shape[0]
+    B = qc_ext.shape[0]
 
     def entry_weights(tf_c, term_c, doc_c):
         df_t = df[term_c]
@@ -188,6 +186,22 @@ def score_coo_impl(tf: jax.Array,         # f32 [nnz_cap]
     init = jnp.zeros((B, doc_cap), jnp.float32)
     scores, _ = jax.lax.scan(body, init, xs)
     return scores
+
+
+def score_coo_impl(tf: jax.Array, term: jax.Array, doc: jax.Array,
+                   doc_len: jax.Array, df: jax.Array, q: QueryBatch,
+                   n_docs: jax.Array, avgdl: jax.Array,
+                   doc_norms: jax.Array | None = None,
+                   *, model: str = "bm25", k1: float = 1.2,
+                   b: float = 0.75, chunk: int = 1 << 17) -> jax.Array:
+    """Score every document in the shard against every query.
+
+    Returns ``scores [B, doc_cap]`` (padded docs score 0; mask in top-k).
+    """
+    slot_of, qc_ext = _compile_queries(q, df.shape[0])
+    return score_coo_compiled(tf, term, doc, doc_len, df, slot_of, qc_ext,
+                              n_docs, avgdl, doc_norms, model=model,
+                              k1=k1, b=b, chunk=chunk)
 
 
 # Jitted entry point for single-shard use; ``score_coo_impl`` stays callable

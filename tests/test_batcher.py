@@ -1,0 +1,148 @@
+"""Micro-batching of concurrent queries into one device batch."""
+
+import threading
+import time
+
+import pytest
+
+from tfidf_tpu.cluster.batcher import QueryBatcher
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.utils.config import Config
+
+TEXTS = {
+    "a.txt": "the quick brown fox",
+    "b.txt": "lazy dog sleeps",
+    "c.txt": "brown dog barks at the fox",
+}
+
+
+class RecordingEngine:
+    """search_batch stub that records batch sizes and echoes queries."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.batches = []
+        self.delay_s = delay_s
+
+    def search_batch(self, queries, k=None, unbounded=False):
+        self.batches.append(len(queries))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [[(q, k, unbounded)] for q in queries]
+
+
+@pytest.fixture
+def engine(tmp_path):
+    cfg = Config(documents_path=str(tmp_path / "docs"),
+                 min_doc_capacity=8, min_nnz_capacity=256,
+                 min_vocab_capacity=64, query_batch=8, max_query_terms=8)
+    e = Engine(cfg)
+    for name, text in TEXTS.items():
+        e.ingest_text(name, text)
+    e.commit()
+    return e
+
+
+def test_single_query_passthrough(engine):
+    b = QueryBatcher(engine, max_batch=8, linger_s=0.0)
+    try:
+        hits = b.search("fox")
+        assert sorted(h.name for h in hits) == ["a.txt", "c.txt"]
+    finally:
+        b.stop()
+
+
+def test_concurrent_queries_all_correct(engine):
+    b = QueryBatcher(engine, max_batch=4, linger_s=0.02)
+    results = {}
+    try:
+        def one(q):
+            results[q] = b.search(q)
+
+        threads = [threading.Thread(target=one, args=(q,))
+                   for q in ("fox", "dog", "brown", "lazy", "barks")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(h.name for h in results["fox"]) == ["a.txt", "c.txt"]
+        assert sorted(h.name for h in results["lazy"]) == ["b.txt"]
+        assert sorted(h.name for h in results["dog"]) == ["b.txt", "c.txt"]
+    finally:
+        b.stop()
+
+
+def test_batches_actually_group():
+    eng = RecordingEngine(delay_s=0.05)   # slow step -> queue piles up
+    b = QueryBatcher(eng, max_batch=8, linger_s=0.02)
+    try:
+        threads = [threading.Thread(target=b.search, args=(f"q{i}",))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert sum(eng.batches) == 8
+        assert max(eng.batches) >= 2, eng.batches
+    finally:
+        b.stop()
+
+
+def test_mixed_parameters_split_into_groups():
+    eng = RecordingEngine(delay_s=0.05)
+    b = QueryBatcher(eng, max_batch=8, linger_s=0.02)
+    out = {}
+    try:
+        def one(q, unbounded):
+            out[q] = b.search(q, unbounded=unbounded)
+
+        threads = [threading.Thread(target=one, args=(f"q{i}", i % 2 == 0))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # every caller got ITS parameters back, not its batchmates'
+        for q, hits in out.items():
+            qq, k, unb = hits[0]
+            assert qq == q
+            assert unb == (int(q[1]) % 2 == 0)
+    finally:
+        b.stop()
+
+
+def test_error_propagates_to_all_waiters():
+    class Boom:
+        def search_batch(self, queries, k=None, unbounded=False):
+            raise ValueError("scoring exploded")
+
+    b = QueryBatcher(Boom(), max_batch=4, linger_s=0.0)
+    try:
+        with pytest.raises(ValueError, match="scoring exploded"):
+            b.search("anything")
+    finally:
+        b.stop()
+
+
+def test_stop_fails_pending_not_hangs():
+    class Slow:
+        def search_batch(self, queries, k=None, unbounded=False):
+            time.sleep(0.2)
+            return [[] for _ in queries]
+
+    b = QueryBatcher(Slow(), max_batch=1, linger_s=0.0)
+    errs = []
+
+    def one():
+        try:
+            b.search("q")
+        except RuntimeError as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    b.stop()
+    for t in threads:
+        t.join(timeout=5)
+        assert not t.is_alive()

@@ -343,3 +343,61 @@ class TestClusterEndToEnd:
                   content_type="application/octet-stream")
         snap = json.loads(http_get(leader.url + "/api/metrics"))
         assert snap.get("uploads_placed", 0) >= 1
+
+
+class TestBoundedClusterSearch:
+    """r2: /worker/process serves exact top-k by default; the reference's
+    unbounded ranking (Worker.java:230) is opt-in parity behavior."""
+
+    def _fill(self, leader, n=25):
+        for i in range(n):
+            http_post(leader.url + f"/leader/upload?name=bulk{i:02d}.txt",
+                      b"shared common token plus unique" +
+                      str(i).encode() * 2,
+                      content_type="application/octet-stream")
+
+    def test_default_returns_top_k(self, cluster):
+        leader = cluster[0]
+        self._fill(leader)
+        res = json.loads(http_post(leader.url + "/leader/start",
+                                   b"shared common token"))
+        assert 0 < len(res) <= leader.config.top_k
+
+    def test_worker_response_is_bounded(self, cluster):
+        leader = cluster[0]
+        self._fill(leader)
+        for w in leader.registry.get_all_service_addresses():
+            hits = json.loads(http_post(w + "/worker/process", b"common"))
+            assert len(hits) <= leader.config.top_k
+
+    def test_unbounded_parity_flag(self, core, tmp_path):
+        nodes = []
+        try:
+            for i in range(2):
+                cfg = Config(
+                    documents_path=str(tmp_path / f"ub{i}" / "documents"),
+                    index_path=str(tmp_path / f"ub{i}" / "index"),
+                    port=0, unbounded_results=True, top_k=2,
+                    min_doc_capacity=64, min_nnz_capacity=1 << 12,
+                    min_vocab_capacity=1 << 10, query_batch=4,
+                    max_query_terms=8)
+                node = SearchNode(cfg, coord=LocalCoordination(core, 0.1))
+                node.start()
+                nodes.append(node)
+            leader = nodes[0]
+            wait_until(lambda: len(
+                leader.registry.get_all_service_addresses()) == 1)
+            for i in range(6):
+                http_post(
+                    leader.url + f"/leader/upload?name=d{i}.txt",
+                    b"same term everywhere",
+                    content_type="application/octet-stream")
+            res = json.loads(http_post(leader.url + "/leader/start",
+                                       b"term"))
+            assert len(res) == 6   # all matches, despite top_k=2
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass

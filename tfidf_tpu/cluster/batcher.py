@@ -1,0 +1,132 @@
+"""Server-side query micro-batching.
+
+The scoring kernels are built for a padded ``[B]`` query batch
+(:mod:`tfidf_tpu.ops.scoring`), but HTTP requests arrive one query at a
+time — the reference scores them one at a time too (``Worker.java:175-186``,
+one Lucene search per POST). Running each request as a batch of one leaves
+most of the device batch idle. The :class:`QueryBatcher` coalesces
+concurrent requests into one device batch: the first arrival waits a short
+linger window for company, then the group is scored in a single
+``search_batch`` call and results are fanned back to the waiting handler
+threads.
+
+Latency math: the linger adds at most ``linger_s`` (default 2 ms) to a lone
+query — noise next to an HTTP round-trip — while under concurrent load B
+queries cost one kernel launch instead of B.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from tfidf_tpu.utils.logging import get_logger
+from tfidf_tpu.utils.metrics import global_metrics
+
+log = get_logger("cluster.batcher")
+
+
+class _Waiter:
+    __slots__ = ("query", "k", "unbounded", "event", "result", "error")
+
+    def __init__(self, query: str, k: int | None, unbounded: bool) -> None:
+        self.query = query
+        self.k = k
+        self.unbounded = unbounded
+        self.event = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+
+class QueryBatcher:
+    """Coalesce concurrent search calls into device-sized batches.
+
+    Thread-safe; callers block until their query's results are ready.
+    Queries with differing (k, unbounded) parameters are grouped into
+    separate batches (they need different post-processing), preserving
+    arrival order within the queue.
+    """
+
+    def __init__(self, engine, max_batch: int = 32,
+                 linger_s: float = 0.002) -> None:
+        self.engine = engine
+        self.max_batch = max(1, max_batch)
+        self.linger_s = linger_s
+        self._lock = threading.Lock()
+        self._items: deque[_Waiter] = deque()
+        self._wake = threading.Event()
+        self._stopping = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="query-batcher")
+        self._thread.start()
+
+    def search(self, query: str, k: int | None = None,
+               unbounded: bool = False):
+        """Submit one query; returns its hit list (blocking)."""
+        if self._stopping:
+            raise RuntimeError("batcher stopped")
+        w = _Waiter(query, k, unbounded)
+        with self._lock:
+            self._items.append(w)
+        self._wake.set()
+        w.event.wait()
+        if w.error is not None:
+            raise w.error
+        return w.result
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+        # fail any stragglers rather than hanging their handler threads
+        with self._lock:
+            items, self._items = list(self._items), deque()
+        for w in items:
+            w.error = RuntimeError("batcher stopped")
+            w.event.set()
+
+    # ---- batcher thread ----
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait()
+            if self._stopping:
+                return
+            # linger: give concurrent requests a moment to pile up so the
+            # device batch fills; a lone query pays at most linger_s
+            if self.linger_s > 0:
+                threading.Event().wait(self.linger_s)
+            batch = self._take_batch()
+            if not batch:
+                continue
+            try:
+                results = self.engine.search_batch(
+                    [w.query for w in batch],
+                    k=batch[0].k, unbounded=batch[0].unbounded)
+                for w, r in zip(batch, results):
+                    w.result = r
+            except Exception as e:
+                for w in batch:
+                    w.error = e
+            for w in batch:
+                w.event.set()
+            global_metrics.inc("query_batches")
+            global_metrics.set_gauge("last_query_batch_size", len(batch))
+
+    def _take_batch(self) -> list[_Waiter]:
+        """Pop the head group: leading queued items sharing the head's
+        (k, unbounded), up to max_batch. Items with other parameters stay
+        queued in order for the next round."""
+        with self._lock:
+            if not self._items:
+                self._wake.clear()
+                return []
+            first = self._items.popleft()
+            batch = [first]
+            while (self._items and len(batch) < self.max_batch
+                   and (self._items[0].k, self._items[0].unbounded)
+                   == (first.k, first.unbounded)):
+                batch.append(self._items.popleft())
+            if not self._items:
+                self._wake.clear()
+        return batch

@@ -41,6 +41,7 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tfidf_tpu.cluster.batcher import QueryBatcher
 from tfidf_tpu.cluster.election import LeaderElection
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
 from tfidf_tpu.engine.engine import Engine
@@ -105,6 +106,13 @@ class SearchNode:
         coord.on_session_event(self._on_session_event)
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="fanout")
+        # concurrent /worker/process requests coalesce into one device
+        # batch (the kernels are built for [B] batches; the reference
+        # scores one query per POST, Worker.java:175-186)
+        self.batcher = (QueryBatcher(
+            self.engine, max_batch=self.config.query_batch,
+            linger_s=self.config.batch_linger_ms / 1e3)
+            if self.config.micro_batch else None)
 
         handler = type("Handler", (_NodeHandler,), {"node": self})
         self.httpd = ThreadingHTTPServer(
@@ -137,6 +145,21 @@ class SearchNode:
         self.httpd.shutdown()
         self.httpd.server_close()
         self._pool.shutdown(wait=False)
+        if self.batcher is not None:
+            self.batcher.stop()
+
+    # ---- worker search path (Worker.java:175-186) ----
+
+    def worker_search(self, query: str) -> list:
+        """Score one query against the local engine. Default: exact top-k
+        through the packed-transfer fast path, micro-batched with
+        concurrent requests. ``unbounded_results=True`` restores the
+        reference's full-ranking behavior (``Worker.java:230``) for
+        parity."""
+        unbounded = self.config.unbounded_results
+        if self.batcher is not None:
+            return self.batcher.search(query, unbounded=unbounded)
+        return self.engine.search(query, unbounded=unbounded)
 
     # ---- session-expiry recovery ----
 
@@ -217,6 +240,13 @@ class SearchNode:
             for hit in hits:
                 name = hit["document"]["name"]
                 merged[name] = merged.get(name, 0.0) + float(hit["score"])
+        if not self.config.unbounded_results:
+            # each document lives on exactly one worker, so the global
+            # top-k is contained in the union of per-worker top-ks —
+            # truncating the merge to k is exact
+            merged = dict(sorted(merged.items(),
+                                 key=lambda kv: (-kv[1], kv[0]))
+                          [:self.config.top_k])
         if self.config.result_order == "name":
             # alphabetical, the reference's TreeMap order (Leader.java:80-91)
             return dict(sorted(merged.items()))
@@ -356,7 +386,7 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 global_injector.check("worker.process")
                 query = self._read_query()
                 try:
-                    hits = node.engine.search(query, unbounded=True)
+                    hits = node.worker_search(query)
                 except Exception as e:
                     # reference returns [] on any failure (Worker.java:183)
                     log.warning("search failed", err=repr(e))

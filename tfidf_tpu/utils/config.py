@@ -47,6 +47,15 @@ class Config:
     # (Leader.java:80-91, comparingByKey). "score" is the sane default.
     result_order: str = "score"  # "score" | "name"
     top_k: int = 10
+    # Parity mode for the cluster data plane: return EVERY matching doc
+    # per query (the reference's Integer.MAX_VALUE top-k, Worker.java:230)
+    # instead of exact top-k. O(corpus) per query — off by default.
+    unbounded_results: bool = False
+    # Server-side micro-batching of concurrent /worker/process queries
+    # into one device batch; the linger is the max extra latency a lone
+    # query pays while waiting for company.
+    micro_batch: bool = True
+    batch_linger_ms: float = 2.0
 
     # --- analyzer ---
     lowercase: bool = True

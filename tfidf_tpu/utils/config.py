@@ -63,6 +63,12 @@ class Config:
     max_token_length: int = 255       # StandardAnalyzer.maxTokenLength default
 
     # --- mesh / parallelism ---
+    # "local": single-device engine (ShardIndex/SegmentedIndex layouts).
+    # "mesh":  the index lives in ShardedArrays on a ("docs","terms")
+    #          device mesh; searches run the distributed shard_map step
+    #          (psum global IDF + all_gather top-k) — the serving path
+    #          that subsumes the reference's whole worker pool.
+    engine_mode: str = "local"         # "local" | "mesh"
     mesh_shape: tuple[int, ...] = ()   # () = all local devices on one "docs" axis
     mesh_axes: tuple[str, ...] = ("docs", "terms")
     query_batch: int = 32              # padded query batch per scoring step

@@ -1,0 +1,64 @@
+"""Multi-host bootstrap plumbing (jax.distributed over DCN, SURVEY §5.8).
+
+Real multi-host needs multiple machines; what is testable on one CPU host
+is the full init path — coordinator service, process handshake, global
+device view — with a 1-process "pod", run in a subprocess so the global
+distributed state never leaks into this test process.
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_initialize_multihost_single_process_pod(tmp_path):
+    port = _free_port()
+    code = textwrap.dedent(f"""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from tfidf_tpu.parallel.mesh import initialize_multihost, make_mesh
+        import jax
+
+        ok = initialize_multihost(
+            coordinator_address="127.0.0.1:{port}",
+            num_processes=1, process_id=0)
+        assert ok, "first call must perform the init"
+        assert jax.process_count() == 1
+        assert jax.process_index() == 0
+        # idempotent: a second call is a no-op
+        assert initialize_multihost() is False
+        # the mesh builds over the (global) device view post-init
+        mesh = make_mesh()
+        assert mesh.devices.size == len(jax.devices())
+        print("MULTIHOST_OK")
+    """)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=120)
+    assert "MULTIHOST_OK" in out.stdout, (out.stdout, out.stderr)
+
+
+def test_serve_distributed_flag_plumbs_config():
+    from tfidf_tpu.cli import build_parser
+    args = build_parser().parse_args(["serve", "--distributed"])
+    assert args.distributed is True
+    args = build_parser().parse_args(["serve"])
+    assert args.distributed is False
+
+
+def test_config_env_overrides():
+    from tfidf_tpu.utils.config import load_config
+    cfg = load_config(env={"TFIDF_DISTRIBUTED": "true",
+                           "TFIDF_DIST_COORDINATOR": "10.0.0.1:8476",
+                           "TFIDF_DIST_NUM_PROCESSES": "4",
+                           "TFIDF_DIST_PROCESS_ID": "2"})
+    assert cfg.distributed is True
+    assert cfg.dist_coordinator == "10.0.0.1:8476"
+    assert cfg.dist_num_processes == 4
+    assert cfg.dist_process_id == 2

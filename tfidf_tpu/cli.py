@@ -51,6 +51,17 @@ def cmd_serve(args) -> int:
     from tfidf_tpu.cluster.node import SearchNode
 
     cfg = _load_cfg(args)
+    if args.distributed:
+        cfg = cfg.replace(distributed=True)
+    if cfg.distributed:
+        # multi-host mesh over DCN: must happen before any backend use so
+        # jax.devices() spans the pod (auto-detected on TPU pods)
+        from tfidf_tpu.parallel.mesh import initialize_multihost
+        initialize_multihost(
+            coordinator_address=cfg.dist_coordinator or None,
+            num_processes=cfg.dist_num_processes or None,
+            process_id=(cfg.dist_process_id
+                        if cfg.dist_process_id >= 0 else None))
     server = None
     if args.embedded_coordinator:
         host, _, port = cfg.coordinator_address.partition(":")
@@ -216,6 +227,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh (distributed shard_map search)")
     s.add_argument("--embedded-coordinator", action="store_true",
                    help="also run the coordination service in-process")
+    s.add_argument("--distributed", action="store_true",
+                   help="multi-host: jax.distributed.initialize before "
+                        "building the mesh (auto-detected on TPU pods; "
+                        "see TFIDF_DIST_* / JAX_* env vars)")
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("coordinator", help="run the coordination service")

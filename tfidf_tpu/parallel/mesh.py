@@ -30,6 +30,51 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from tfidf_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.mesh")
+
+_distributed_initialized = False
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> bool:
+    """Multi-host bootstrap over DCN — ``jax.distributed.initialize``
+    (SURVEY.md §5.8's prescribed TPU-native equivalent of the reference's
+    ZooKeeper-discovered pod set).
+
+    On TPU pods every argument is auto-detected from the TPU metadata
+    server, so ``serve --distributed`` needs no flags there. Elsewhere
+    (GPU/CPU clusters, tests) pass them explicitly or set the standard
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+    environment variables (read by jax itself).
+
+    After this returns, ``jax.devices()`` spans all hosts and
+    :func:`make_mesh` builds a global mesh — the ``docs`` axis rides DCN
+    (embarrassingly parallel shards, one k-sized gather per query) and
+    ``terms`` rides ICI (per-query psum), per the module docstring above.
+
+    Idempotent: returns True only when this call performed the
+    initialization.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return False
+    kw = {}
+    if coordinator_address:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+    _distributed_initialized = True
+    log.info("jax.distributed initialized",
+             process=jax.process_index(), processes=jax.process_count(),
+             devices=len(jax.devices()))
+    return True
+
 
 def default_mesh_shape(n_devices: int | None = None) -> tuple[int, int]:
     """(docs, terms) shape: favor the docs axis, keep terms a small power of 2.

@@ -71,6 +71,14 @@ class Config:
     engine_mode: str = "local"         # "local" | "mesh"
     mesh_shape: tuple[int, ...] = ()   # () = all local devices on one "docs" axis
     mesh_axes: tuple[str, ...] = ("docs", "terms")
+    # Multi-host bootstrap (jax.distributed over DCN). On TPU pods the
+    # coordinator/process values are auto-detected; leave the defaults.
+    # Elsewhere set them (or the standard JAX_COORDINATOR_ADDRESS /
+    # JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars).
+    distributed: bool = False
+    dist_coordinator: str = ""         # host:port of process 0
+    dist_num_processes: int = 0        # 0 = auto-detect
+    dist_process_id: int = -1          # -1 = auto-detect
     query_batch: int = 32              # padded query batch per scoring step
     max_query_terms: int = 32          # padded terms per query
 

@@ -1,0 +1,170 @@
+"""Independent Lucene 9 BM25 golden generator for the reference corpus.
+
+Implements the Java system's scoring stack from the Lucene specification —
+deliberately WITHOUT importing any tfidf_tpu code, so it can serve as the
+golden oracle the engine's ``lucene_parity=True`` mode is checked against
+(the correctness bar of BASELINE.md: identical results vs the Java/Lucene
+baseline; reference path: ``Worker.java:222-241`` scoring +
+``Leader.java:39-92`` merge).
+
+Pieces, each per the documented Lucene 9 behavior:
+
+* StandardAnalyzer: Unicode word-break tokenization (alphanumeric runs for
+  this ASCII corpus) + lowercase, no stopwords (Lucene 9 default).
+* Norm encoding: document length round-trips through
+  ``SmallFloat.intToByte4``/``byte4ToInt`` — a lossy 4-mantissa-bit code —
+  before entering the BM25 length normalization.
+* BM25Similarity (k1=1.2, b=0.75), Lucene 8+ form without the (k1+1)
+  numerator: ``idf * tf / (tf + k1 * (1 - b + b * dl_q / avgdl))`` with
+  ``idf = ln(1 + (N - df + 0.5) / (df + 0.5))``; ``avgdl`` from EXACT
+  lengths (sumTotalTermFreq / docCount), ``dl_q`` the quantized length.
+* Per-shard statistics: each worker scores against its local df/N
+  (cross-shard IDF is never globalized in the reference).
+* Leader merge: sum scores per doc name, order alphabetically
+  (``Leader.java:73-91``).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+K1 = 1.2
+B = 0.75
+
+_TOKEN = re.compile(r"[0-9a-z]+")
+
+
+def analyze(text: str) -> list[str]:
+    return _TOKEN.findall(text.lower())
+
+
+# SmallFloat byte-4 codec, from the org.apache.lucene.util.SmallFloat
+# spec: values 0..39 exact, then 3 mantissa bits + exponent.
+
+def _long_to_int4(i: int) -> int:
+    num_bits = i.bit_length()
+    if num_bits < 4:
+        return i
+    shift = num_bits - 4
+    return ((i >> shift) & 0x07) | ((shift + 1) << 3)
+
+
+def _int4_to_long(i: int) -> int:
+    bits = i & 0x07
+    shift = (i >> 3) - 1
+    return bits if shift == -1 else (bits | 0x08) << shift
+
+
+_FREE = 255 - _long_to_int4(2**31 - 1)
+
+
+def quantize_dl(dl: int) -> int:
+    b = dl if dl < _FREE else _FREE + _long_to_int4(dl - _FREE)
+    return b if b < _FREE else _FREE + _int4_to_long(b - _FREE)
+
+
+class LuceneShard:
+    """One worker's Lucene index (local statistics)."""
+
+    def __init__(self, docs: dict[str, str]) -> None:
+        self.tf: dict[str, dict[str, int]] = {}
+        self.dl: dict[str, int] = {}
+        for name, text in docs.items():
+            toks = analyze(text)
+            counts: dict[str, int] = {}
+            for t in toks:
+                counts[t] = counts.get(t, 0) + 1
+            self.tf[name] = counts
+            self.dl[name] = len(toks)
+        self.n = len(docs)
+        self.avgdl = (sum(self.dl.values()) / self.n) if self.n else 1.0
+        self.df: dict[str, int] = {}
+        for counts in self.tf.values():
+            for t in counts:
+                self.df[t] = self.df.get(t, 0) + 1
+
+    def idf(self, t: str) -> float:
+        df = self.df.get(t, 0)
+        return math.log(1.0 + (self.n - df + 0.5) / (df + 0.5))
+
+    def search(self, query: str) -> dict[str, float]:
+        """Unbounded search (``Integer.MAX_VALUE``): every doc matching at
+        least one query term, with its BM25 score."""
+        q_terms = analyze(query)
+        out: dict[str, float] = {}
+        for name, counts in self.tf.items():
+            s = 0.0
+            hit = False
+            for t in q_terms:
+                tf = counts.get(t, 0)
+                if tf == 0:
+                    continue
+                hit = True
+                dl_q = float(quantize_dl(self.dl[name]))
+                norm = K1 * (1.0 - B + B * dl_q / self.avgdl)
+                s += self.idf(t) * tf / (tf + norm)
+            if hit:
+                out[name] = s
+        return out
+
+
+def leader_search(shards: list[LuceneShard], query: str
+                  ) -> dict[str, float]:
+    """Scatter-gather: sum-merge per name, alphabetical order."""
+    merged: dict[str, float] = {}
+    for shard in shards:
+        for name, score in shard.search(query).items():
+            merged[name] = merged.get(name, 0.0) + score
+    return dict(sorted(merged.items()))
+
+
+QUERIES = [
+    "fast food",
+    "cat meowing",
+    "kheder",
+    "wireless earbuds",
+    "helo",
+    "best wireless earbuds 2024",
+    "night causes",
+    "food",
+]
+
+
+def generate(corpus_dir: str) -> dict:
+    import json
+    import os
+
+    docs = {}
+    for fn in sorted(os.listdir(corpus_dir)):
+        path = os.path.join(corpus_dir, fn)
+        if fn.endswith(".txt") and os.path.isfile(path):
+            with open(path, encoding="utf-8") as f:
+                docs[fn] = f.read()
+    names = sorted(docs)
+    # two shard layouts: everything on one worker, and the 2-worker split
+    # the reference would produce with files alternating by upload order
+    one = [LuceneShard(docs)]
+    w0 = LuceneShard({n: docs[n] for n in names[0::2]})
+    w1 = LuceneShard({n: docs[n] for n in names[1::2]})
+    goldens = {
+        "queries": QUERIES,
+        "single_worker": {q: leader_search(one, q) for q in QUERIES},
+        "two_workers": {q: leader_search([w0, w1], q) for q in QUERIES},
+        "two_worker_split": {"w0": names[0::2], "w1": names[1::2]},
+    }
+    return goldens
+
+
+if __name__ == "__main__":
+    import json
+    import os
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    corpus = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        here, "..", "demo", "corpus")
+    out = os.path.join(here, "data", "lucene_goldens.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(generate(corpus), f, indent=1, sort_keys=True)
+    print(f"wrote {out}")

@@ -235,10 +235,11 @@ class MeshIndex:
                     mask[s, local] = 1.0
         return mask
 
-    def _entries_to_coo(self, entries: list[DocEntry],
-                        vocab_cap: int) -> CooShard:
+    def _entries_to_coo(self, entries: list[DocEntry], vocab_cap: int
+                        ) -> tuple[CooShard, np.ndarray]:
         """Concatenation-order COO (NOT length-sorted — placement is
-        ``i % D``, so order IS the layout; cf. ``shard_documents``)."""
+        ``i % D``, so order IS the layout; cf. ``shard_documents``).
+        Returns (coo with model-transformed lengths, raw lengths)."""
         n = len(entries)
         sizes = np.fromiter((d.term_ids.shape[0] for d in entries),
                             np.int64, n)
@@ -256,7 +257,8 @@ class MeshIndex:
         raw_len = np.fromiter((d.length for d in entries), np.float32, n)
         doc_len = self.model.transform_doc_len(raw_len).astype(np.float32)
         return CooShard(tf=tf[:nnz], term=term[:nnz], doc=doc[:nnz],
-                        doc_len=doc_len, df=df, nnz=nnz, num_docs=n)
+                        doc_len=doc_len, df=df, nnz=nnz,
+                        num_docs=n), raw_len
 
     def _rebuild_locked(self, pending: list[DocEntry],
                         vocab_cap: int) -> ShardedArrays:
@@ -266,10 +268,10 @@ class MeshIndex:
         for sd in self._shard_docs:
             entries.extend(d for d in sd if d.live)
         entries.extend(pending)
-        coo = self._entries_to_coo(entries, vocab_cap)
+        coo, raw_len = self._entries_to_coo(entries, vocab_cap)
         arrays = build_sharded_arrays(
             coo, self.mesh, min_chunk_cap=self.min_chunk_cap,
-            min_doc_cap=self.min_doc_cap)
+            min_doc_cap=self.min_doc_cap, raw_doc_len=raw_len)
         # fresh list objects: snapshots taken before this rebuild keep the
         # old lists (and the old arrays), staying internally consistent
         self._shard_docs = [[] for _ in range(self.D)]
@@ -311,10 +313,12 @@ class MeshIndex:
                 np.asarray([e.length for e in es], np.float32))
                 .astype(np.float32)) if es else []
             for es in per_entries]
+        per_raw = [[e.length for e in es] for es in per_entries]
         max_entries = max((sum(e.term_ids.shape[0] for e in es)
                            for es in per_entries), default=0)
         C = next_capacity(max(-(-max_entries // self.T), 1), 64)
-        batch = build_ingest_batch(self.mesh, arrays, per_docs, per_lens, C)
+        batch = build_ingest_batch(self.mesh, arrays, per_docs, per_lens, C,
+                                   raw_lengths_per_shard=per_raw)
         if self._ingest_fn is None:
             self._ingest_fn = make_sharded_ingest(self.mesh)
         arrays = self._ingest_fn(arrays, *batch)

@@ -60,6 +60,10 @@ class ShardedArrays:
     # docs keep their postings (and stay in df/avgdl until a re-shard
     # compaction, like Lucene until merge) but score 0.
     live: jax.Array      # f32 [D, doc_cap] — 1=live, 0=tombstone/pad
+    # Sum of RAW (pre-norm-quantization) lengths per shard: avgdl must be
+    # computed from exact lengths (Lucene: sumTotalTermFreq / docCount)
+    # even when doc_len holds SmallFloat-quantized values (parity mode).
+    len_sum: jax.Array   # f32 [D]
     doc_cap: int
     vocab_cap: int
 
@@ -71,7 +75,7 @@ class ShardedArrays:
 jax.tree_util.register_dataclass(
     ShardedArrays,
     data_fields=["tf", "term", "doc", "doc_len", "df", "n_live", "nnz_used",
-                 "live"],
+                 "live", "len_sum"],
     meta_fields=["doc_cap", "vocab_cap"],
 )
 
@@ -98,7 +102,9 @@ def build_sharded_arrays(shard: CooShard,
                          mesh: Mesh,
                          min_chunk_cap: int = 1 << 14,
                          min_doc_cap: int = 1024,
-                         headroom: float = 0.25) -> ShardedArrays:
+                         headroom: float = 0.25,
+                         raw_doc_len: np.ndarray | None = None
+                         ) -> ShardedArrays:
     """Partition one host COO shard across a (docs, terms) mesh.
 
     Returns device arrays placed with NamedShardings so each mesh slice
@@ -106,6 +112,10 @@ def build_sharded_arrays(shard: CooShard,
     buckets so subsequent on-device appends have a free tail even when the
     exact need lands on a power-of-two boundary (otherwise a rebuild right
     at a boundary would overflow on the very next commit).
+
+    ``raw_doc_len`` (defaults to ``shard.doc_len``): exact pre-quantization
+    lengths, used only for the per-shard avgdl sums — pass it when
+    ``shard.doc_len`` holds norm-transformed values (Lucene parity).
     """
     D = mesh.shape["docs"]
     T = mesh.shape["terms"]
@@ -166,6 +176,11 @@ def build_sharded_arrays(shard: CooShard,
 
     g_live = (np.arange(doc_cap)[None, :]
               < counts[:, None]).astype(np.float32)
+    raw = (np.asarray(raw_doc_len) if raw_doc_len is not None
+           else doc_len_src)[:n_docs]
+    g_len_sum = np.zeros(D, np.float32)
+    for s in range(D):
+        g_len_sum[s] = float(raw[assign == s].sum())
     return ShardedArrays(
         tf=put(g_tf, P("docs", "terms", None)),
         term=put(g_term, P("docs", "terms", None)),
@@ -175,6 +190,7 @@ def build_sharded_arrays(shard: CooShard,
         n_live=put(counts.astype(np.int32), P("docs")),
         nnz_used=put(g_used, P("docs", "terms")),
         live=put(g_live, P("docs", None)),
+        len_sum=put(g_len_sum, P("docs")),
         doc_cap=doc_cap,
         vocab_cap=vocab_cap,
     )
@@ -209,7 +225,7 @@ def make_sharded_search(mesh: Mesh,
     for parity testing.
     """
 
-    def step(tf, term, doc, doc_len, df, n_live, live,
+    def step(tf, term, doc, doc_len, df, n_live, live, len_sum,
              q_uniq, q_n_uniq, q_slots, q_weights):
         q = QueryBatch(q_uniq, q_n_uniq, q_slots, q_weights)
         tf = tf.reshape(tf.shape[-1])
@@ -219,6 +235,7 @@ def make_sharded_search(mesh: Mesh,
         df_local = df.reshape(df.shape[-1])
         n_local = n_live.reshape(())
         live = live.reshape(live.shape[-1])
+        len_local = len_sum.reshape(())
 
         doc_cap = doc_len.shape[0]
 
@@ -228,13 +245,13 @@ def make_sharded_search(mesh: Mesh,
             # axes, so summing both is exact).
             df_eff = jax.lax.psum(df_local, ("docs", "terms"))
             n_eff = jax.lax.psum(n_local.astype(jnp.float32), "docs")
-            total_len = jax.lax.psum(jnp.sum(doc_len), "docs")
+            total_len = jax.lax.psum(len_local, "docs")
             avgdl = total_len / jnp.maximum(n_eff, 1.0)
         else:
             # Parity mode: per-docs-shard stats, as each Java worker sees.
             df_eff = jax.lax.psum(df_local, "terms")
             n_eff = n_local.astype(jnp.float32)
-            avgdl = jnp.sum(doc_len) / jnp.maximum(n_eff, 1.0)
+            avgdl = len_local / jnp.maximum(n_eff, 1.0)
 
         doc_norms = None
         if model == "tfidf_cosine":
@@ -265,6 +282,7 @@ def make_sharded_search(mesh: Mesh,
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", None),
                   P("docs", "terms", None), P("docs"), P("docs", None),
+                  P("docs"),
                   P(None), P(), P(None, None), P(None, None)),
         out_specs=(P(), P()),
         check_vma=False,
@@ -274,6 +292,7 @@ def make_sharded_search(mesh: Mesh,
     def search(arrays: ShardedArrays, q: QueryBatch):
         return sharded(arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
                        arrays.df, arrays.n_live, arrays.live,
+                       arrays.len_sum,
                        jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
                        jnp.asarray(q.slots), jnp.asarray(q.weights))
 
@@ -297,7 +316,7 @@ def make_sharded_scores(mesh: Mesh,
     this never rides the serving fast path.
     """
 
-    def step(tf, term, doc, doc_len, df, n_live, live,
+    def step(tf, term, doc, doc_len, df, n_live, live, len_sum,
              q_uniq, q_n_uniq, q_slots, q_weights):
         q = QueryBatch(q_uniq, q_n_uniq, q_slots, q_weights)
         tf = tf.reshape(tf.shape[-1])
@@ -307,17 +326,18 @@ def make_sharded_scores(mesh: Mesh,
         df_local = df.reshape(df.shape[-1])
         n_local = n_live.reshape(())
         live = live.reshape(live.shape[-1])
+        len_local = len_sum.reshape(())
         doc_cap = doc_len.shape[0]
 
         if global_idf:
             df_eff = jax.lax.psum(df_local, ("docs", "terms"))
             n_eff = jax.lax.psum(n_local.astype(jnp.float32), "docs")
-            total_len = jax.lax.psum(jnp.sum(doc_len), "docs")
+            total_len = jax.lax.psum(len_local, "docs")
             avgdl = total_len / jnp.maximum(n_eff, 1.0)
         else:
             df_eff = jax.lax.psum(df_local, "terms")
             n_eff = n_local.astype(jnp.float32)
-            avgdl = jnp.sum(doc_len) / jnp.maximum(n_eff, 1.0)
+            avgdl = len_local / jnp.maximum(n_eff, 1.0)
 
         doc_norms = None
         if model == "tfidf_cosine":
@@ -336,6 +356,7 @@ def make_sharded_scores(mesh: Mesh,
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", None),
                   P("docs", "terms", None), P("docs"), P("docs", None),
+                  P("docs"),
                   P(None), P(), P(None, None), P(None, None)),
         out_specs=P("docs", None, None),
         check_vma=False,
@@ -345,6 +366,7 @@ def make_sharded_scores(mesh: Mesh,
     def scores(arrays: ShardedArrays, q: QueryBatch):
         return sharded(arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
                        arrays.df, arrays.n_live, arrays.live,
+                       arrays.len_sum,
                        jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
                        jnp.asarray(q.slots), jnp.asarray(q.weights))
 
@@ -355,7 +377,9 @@ def build_ingest_batch(mesh: Mesh,
                        arrays: ShardedArrays,
                        new_docs_per_shard: list[list[dict[int, int]]],
                        lengths_per_shard: list[list[float]],
-                       batch_chunk_cap: int):
+                       batch_chunk_cap: int,
+                       raw_lengths_per_shard: list[list[float]] | None
+                       = None):
     """Vectorize new documents into a device-ready ingest batch.
 
     ``new_docs_per_shard[d]`` holds the new docs placed on docs-shard d
@@ -393,6 +417,10 @@ def build_ingest_batch(mesh: Mesh,
     new_count = np.zeros((D, T), np.int32)
     new_len = np.zeros((D, L), np.float32)
     new_docs = np.zeros(D, np.int32)
+    # avgdl delta uses RAW lengths (doc_len may hold quantized values)
+    raws = (raw_lengths_per_shard if raw_lengths_per_shard is not None
+            else lengths_per_shard)
+    new_len_sum = np.asarray([float(sum(r)) for r in raws], np.float32)
     for d in range(D):
         docs = new_docs_per_shard[d]
         lens = lengths_per_shard[d]
@@ -431,7 +459,8 @@ def build_ingest_batch(mesh: Mesh,
             put(new_doc, P("docs", "terms", None)),
             put(new_count, P("docs", "terms")),
             put(new_len, P("docs", None)),
-            put(new_docs, P("docs")))
+            put(new_docs, P("docs")),
+            put(new_len_sum, P("docs")))
 
 
 def make_sharded_ingest(mesh: Mesh):
@@ -459,8 +488,9 @@ def make_sharded_ingest(mesh: Mesh):
                new_len [D,L], new_docs [D]) -> ShardedArrays
     """
 
-    def step(tf, term, doc, doc_len, df, n_live, nnz_used, live,
-             new_tf, new_term, new_doc, new_count, new_len, new_docs):
+    def step(tf, term, doc, doc_len, df, n_live, nnz_used, live, len_sum,
+             new_tf, new_term, new_doc, new_count, new_len, new_docs,
+             new_len_sum):
         tf = tf.reshape(tf.shape[-1])
         term = term.reshape(term.shape[-1])
         doc = doc.reshape(doc.shape[-1])
@@ -469,12 +499,14 @@ def make_sharded_ingest(mesh: Mesh):
         n_live = n_live.reshape(())
         used = nnz_used.reshape(())
         live = live.reshape(live.shape[-1])
+        len_sum = len_sum.reshape(())
         new_tf = new_tf.reshape(new_tf.shape[-1])
         new_term = new_term.reshape(new_term.shape[-1])
         new_doc = new_doc.reshape(new_doc.shape[-1])
         new_count = new_count.reshape(())
         new_len = new_len.reshape(new_len.shape[-1])
         new_docs = new_docs.reshape(())
+        new_len_sum = new_len_sum.reshape(())
 
         vocab_cap = df.shape[0]
         tf2 = jax.lax.dynamic_update_slice(tf, new_tf, (used,))
@@ -495,7 +527,8 @@ def make_sharded_ingest(mesh: Mesh):
         used2 = used + new_count
         return (tf2[None, None], term2[None, None], doc2[None, None],
                 doc_len2[None], df2[None, None], n2[None],
-                used2[None, None], live2[None])
+                used2[None, None], live2[None],
+                (len_sum + new_len_sum)[None])
 
     sharded = jax.shard_map(
         step,
@@ -503,27 +536,29 @@ def make_sharded_ingest(mesh: Mesh):
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", None),
                   P("docs", "terms", None), P("docs"), P("docs", "terms"),
-                  P("docs", None),
+                  P("docs", None), P("docs"),
                   P("docs", "terms", None), P("docs", "terms", None),
                   P("docs", "terms", None), P("docs", "terms"),
-                  P("docs", None), P("docs")),
+                  P("docs", None), P("docs"), P("docs")),
         out_specs=(P("docs", "terms", None), P("docs", "terms", None),
                    P("docs", "terms", None), P("docs", None),
                    P("docs", "terms", None), P("docs"),
-                   P("docs", "terms"), P("docs", None)),
+                   P("docs", "terms"), P("docs", None), P("docs")),
         check_vma=False,
     )
 
     @jax.jit
     def ingest(arrays: ShardedArrays, new_tf, new_term, new_doc, new_count,
-               new_len, new_docs):
-        tf, term, doc, doc_len, df, n_live, nnz_used, live = sharded(
+               new_len, new_docs, new_len_sum):
+        (tf, term, doc, doc_len, df, n_live, nnz_used, live,
+         len_sum) = sharded(
             arrays.tf, arrays.term, arrays.doc, arrays.doc_len, arrays.df,
-            arrays.n_live, arrays.nnz_used, arrays.live,
-            new_tf, new_term, new_doc, new_count, new_len, new_docs)
+            arrays.n_live, arrays.nnz_used, arrays.live, arrays.len_sum,
+            new_tf, new_term, new_doc, new_count, new_len, new_docs,
+            new_len_sum)
         return ShardedArrays(
             tf=tf, term=term, doc=doc, doc_len=doc_len, df=df,
-            n_live=n_live, nnz_used=nnz_used, live=live,
+            n_live=n_live, nnz_used=nnz_used, live=live, len_sum=len_sum,
             doc_cap=arrays.doc_cap, vocab_cap=arrays.vocab_cap)
 
     return ingest
@@ -547,12 +582,13 @@ def with_live_mask(mesh: Mesh, arrays: ShardedArrays,
 # ---- ShardedArrays checkpoint (mesh-scale Worker.java:88 commit) ----
 
 _CKPT_FIELDS = ("tf", "term", "doc", "doc_len", "df", "n_live",
-                "nnz_used", "live")
+                "nnz_used", "live", "len_sum")
 _CKPT_SPECS = {
     "tf": P("docs", "terms", None), "term": P("docs", "terms", None),
     "doc": P("docs", "terms", None), "doc_len": P("docs", None),
     "df": P("docs", "terms", None), "n_live": P("docs"),
     "nnz_used": P("docs", "terms"), "live": P("docs", None),
+    "len_sum": P("docs"),
 }
 
 

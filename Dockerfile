@@ -1,0 +1,37 @@
+# tfidf_tpu node image — the single-binary deployment surface
+# (the analog of the reference's fat-jar image,
+# TF-IDF-System-Core/Dockerfile:1-9: one image, every node runs it,
+# role decided at runtime by leader election).
+#
+# For TPU nodes, build FROM a JAX TPU base instead (e.g. a
+# python:3.11 image + `pip install 'jax[tpu]'`) and schedule onto
+# TPU node pools; the CPU base below runs the full system (engine,
+# cluster, coordination) on any k8s cluster.
+
+FROM python:3.11-slim
+
+# native toolchain for the C++ ingest fast path (tfidf_tpu/native);
+# the engine falls back to pure Python when no compiler is present,
+# so this layer is an optimization, not a requirement
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+COPY pyproject.toml README.md ./
+COPY tfidf_tpu ./tfidf_tpu
+RUN pip install --no-cache-dir "jax[cpu]" numpy && \
+    pip install --no-cache-dir --no-deps .
+
+# documents + index live on volumes (reference: /app/documents,
+# /app/lucene-index — README.MD:93-107)
+ENV TFIDF_DOCUMENTS_PATH=/app/documents \
+    TFIDF_INDEX_PATH=/app/index \
+    TFIDF_PORT=8085
+VOLUME ["/app/documents", "/app/index"]
+
+EXPOSE 8085
+
+ENTRYPOINT ["python", "-m", "tfidf_tpu"]
+CMD ["serve"]

@@ -1,0 +1,73 @@
+"""Deployment artifacts stay structurally valid (VERDICT r1 #7).
+
+No kubectl/docker in CI, so these are structural dry-runs: the manifest
+must parse and carry the reference layout's load-bearing pieces
+(3 replicas, pod anti-affinity, Downward-API pod IP, coordinator service,
+volumes — reference README.MD:49-108), and the Dockerfile must install
+the package and run the node entrypoint.
+"""
+
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.join(HERE, "..")
+
+
+def test_k8s_manifest_structure():
+    with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds == ["Deployment", "Deployment", "Namespace",
+                     "Service", "Service"]
+    deployments = {d["metadata"]["name"]: d for d in docs
+                   if d["kind"] == "Deployment"}
+    assert set(deployments) == {"tfidf-coordinator", "tfidf-node"}
+
+    node = deployments["tfidf-node"]["spec"]
+    assert node["replicas"] == 3
+    pod = node["template"]["spec"]
+    anti = pod["affinity"]["podAntiAffinity"]
+    rule = anti["requiredDuringSchedulingIgnoredDuringExecution"][0]
+    assert rule["topologyKey"] == "kubernetes.io/hostname"
+
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    # Downward-API pod IP, like the reference's POD_IP
+    assert env["TFIDF_HOST"]["valueFrom"]["fieldRef"][
+        "fieldPath"] == "status.podIP"
+    assert env["TFIDF_COORDINATOR_ADDRESS"]["value"] == (
+        "tfidf-coordinator:2181")
+    # every env var must be a real Config field
+    from tfidf_tpu.utils.config import Config
+    fields = {f.upper() for f in Config.__dataclass_fields__}
+    for name in env:
+        assert name.startswith("TFIDF_")
+        assert name[len("TFIDF_"):] in fields, name
+
+    mounts = {m["name"]: m["mountPath"]
+              for m in pod["containers"][0]["volumeMounts"]}
+    assert mounts == {"documents": "/app/documents", "index": "/app/index"}
+    vols = {v["name"] for v in pod["volumes"]}
+    assert vols == {"documents", "index"}
+
+    coord = deployments["tfidf-coordinator"]["spec"]["template"]["spec"]
+    assert "coordinator" in coord["containers"][0]["args"]
+
+
+def test_dockerfile_structure():
+    with open(os.path.join(ROOT, "Dockerfile")) as f:
+        content = f.read()
+    assert "COPY tfidf_tpu" in content
+    assert 'ENTRYPOINT ["python", "-m", "tfidf_tpu"]' in content
+    assert "EXPOSE 8085" in content
+    # env defaults must be real Config fields
+    from tfidf_tpu.utils.config import Config
+    fields = {f.upper() for f in Config.__dataclass_fields__}
+    for line in content.splitlines():
+        line = line.strip().lstrip("ENV").strip()
+        if line.startswith("TFIDF_"):
+            name = line.split("=")[0]
+            assert name[len("TFIDF_"):] in fields, name

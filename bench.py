@@ -1,15 +1,29 @@
-"""Benchmark: batched query scoring on TPU vs a vectorized CPU baseline.
+"""Benchmark: the BASELINE.md configs on the local chip.
 
-Config 1 of BASELINE.md (20-Newsgroups scale: ~18k docs, ~60k vocab),
-synthesized with a Zipfian term distribution since the environment has no
-network egress. The pipeline measured is the real one: text -> analyzer ->
-vocab -> COO commit -> device scoring with exact top-10.
+Three configs per BASELINE.md:
 
-The baseline (denominator of ``vs_baseline``) is the same scoring math run
-as fully vectorized numpy on the host CPU — a *stronger* stand-in for the
-reference's per-worker scoring loop than the Java system itself (which
-scores one query at a time over HTTP, ``Leader.java:51-70``); beating it is
-beating an optimistic reference.
+* **config 3 (primary, north-star)** — 1M docs / 500k vocab, batched
+  multi-query exact top-10. Corpus is synthesized directly as sorted
+  (term id, tf) arrays (vectorized, Zipfian) and ingested through
+  ``add_document_arrays`` — the same entry the native tokenizer feeds —
+  so the measured path is index build -> ELL commit -> device scoring.
+* **config 1** — 18k docs / ~60k vocab with the FULL text pipeline
+  (analyzer -> vocab -> index), for ingest docs/s through the real
+  tokenizer and continuity with round 1.
+* **config 4 (shape)** — streaming ingest in ``index_mode="segments"``:
+  sustained docs/s over 100k docs with a commit every 10k (commit cost
+  O(new docs), which rebuild mode cannot do).
+
+CPU baselines (the ``vs_baseline`` denominator is the STRONGEST one at
+the same config — VERDICT r1 #5):
+
+* scipy CSR sparse matmul over precomputed BM25 impacts — the classic
+  strong CPU implementation of batched sparse scoring;
+* torch sparse-CSR matmul (MKL; multithreaded where cores exist);
+* the round-1 vectorized-numpy scorer (config 1 only, for continuity).
+
+This host exposes a single CPU core; the baselines are still the best
+single-core sparse kernels available, and per-core numbers are reported.
 
 Prints exactly ONE JSON line on stdout:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -32,26 +46,68 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
-N_DOCS = 18_000
-VOCAB = 60_000
-AVG_LEN = 150
-BATCH = 2048           # TPU thrives on big batches; the remote-TPU link's
-                        # ~100ms/fetch fixed cost amortizes over the batch
-N_BATCHES = 4           # timed batches (tpu side)
-CPU_BATCH = 32
-CPU_BATCHES = 4         # numpy baseline is slow; extrapolate from fewer
-TOP_K = 10
 SEED = 0
+TOP_K = 10
+
+# config 3 — the north star
+NS_DOCS = 1_000_000
+NS_VOCAB = 500_000
+NS_AVG_LEN = 120
+NS_BATCH = 256
+NS_BATCHES = 4
+NS_CPU_BATCH = 32
+NS_CPU_BATCHES = 2
+
+# config 1 — full text pipeline
+C1_DOCS = 18_000
+C1_VOCAB = 60_000
+C1_AVG_LEN = 150
+C1_BATCH = 2048
+C1_BATCHES = 2
+
+# config 4 shape — streaming segments
+ST_DOCS = 100_000
+ST_COMMIT_EVERY = 10_000
+ST_AVG_LEN = 100
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_corpus(rng) -> list[str]:
-    """Zipfian synthetic corpus as raw text (exercises the full ingest)."""
-    zipf = rng.zipf(1.25, size=N_DOCS * AVG_LEN) % VOCAB
-    lengths = np.clip(rng.poisson(AVG_LEN, N_DOCS), 10, None)
+# --------------------------------------------------------------------------
+# corpus synthesis
+# --------------------------------------------------------------------------
+
+def make_doc_arrays(rng, n_docs: int, vocab: int, avg_len: int):
+    """Vectorized Zipfian corpus as per-doc sorted (ids, tfs) slices.
+
+    Returns (offsets [n+1], ids [nnz], tfs [nnz], lengths [n]) where doc i
+    owns ids[offsets[i]:offsets[i+1]] sorted ascending — exactly the
+    ``add_document_arrays`` contract the native tokenizer produces.
+    """
+    lengths = np.clip(rng.poisson(avg_len, n_docs), 5, None).astype(np.int64)
+    total = int(lengths.sum())
+    terms = (rng.zipf(1.25, size=total) % vocab).astype(np.int64)
+    doc_of = np.repeat(np.arange(n_docs, dtype=np.int64), lengths)
+    # unique (doc, term) pairs + counts, all vectorized
+    order = np.lexsort((terms, doc_of))
+    d = doc_of[order]
+    t = terms[order]
+    first = np.ones(total, bool)
+    first[1:] = (d[1:] != d[:-1]) | (t[1:] != t[:-1])
+    idx = np.flatnonzero(first)
+    counts = np.diff(np.append(idx, total))
+    ud, ut = d[idx], t[idx]
+    offsets = np.searchsorted(ud, np.arange(n_docs + 1))
+    return (offsets, ut.astype(np.int32), counts.astype(np.float32),
+            lengths.astype(np.float32))
+
+
+def make_texts(rng, n_docs: int, vocab: int, avg_len: int) -> list[str]:
+    """Raw-text corpus (exercises the full analyzer/vocab ingest)."""
+    zipf = rng.zipf(1.25, size=n_docs * avg_len) % vocab
+    lengths = np.clip(rng.poisson(avg_len, n_docs), 10, None)
     lengths = (lengths * (zipf.shape[0] / lengths.sum())).astype(np.int64)
     texts = []
     pos = 0
@@ -62,129 +118,304 @@ def make_corpus(rng) -> list[str]:
     return texts
 
 
-def make_queries(rng, vocab_size: int, n: int) -> list[str]:
+def make_queries(rng, vocab: int, n: int) -> list[str]:
     out = []
     for _ in range(n):
         k = int(rng.integers(2, 5))
-        # query terms skewed like the corpus so they actually hit postings
-        ids = rng.zipf(1.25, size=k) % vocab_size
+        ids = rng.zipf(1.25, size=k) % vocab
         out.append(" ".join(f"t{w}" for w in ids))
     return out
 
 
-def bench_tpu(texts: list[str], queries: list[str]) -> tuple[float, float]:
+# --------------------------------------------------------------------------
+# config 3: north star — 1M docs / 500k vocab
+# --------------------------------------------------------------------------
+
+def bench_north_star(rng) -> dict:
     from tfidf_tpu.engine import Engine
     from tfidf_tpu.utils.config import Config
 
-    engine = Engine(Config(query_batch=BATCH))
-    # pass 1 (untimed): warms XLA compiles for this corpus's capacity
-    # buckets — a serving node pays this once per process lifetime
     t0 = time.perf_counter()
+    offsets, ids, tfs, lengths = make_doc_arrays(
+        rng, NS_DOCS, NS_VOCAB, NS_AVG_LEN)
+    nnz = ids.shape[0]
+    log(f"[ns] corpus: {NS_DOCS} docs, nnz={nnz}, "
+        f"gen {time.perf_counter()-t0:.1f}s")
+
+    engine = Engine(Config(query_batch=NS_BATCH))
+    t0 = time.perf_counter()
+    for i in range(NS_VOCAB):
+        engine.vocab.add(f"t{i}")
+    log(f"[ns] vocab registered in {time.perf_counter()-t0:.1f}s")
+
+    t0 = time.perf_counter()
+    add = engine.index.add_document_arrays
+    for i in range(NS_DOCS):
+        lo, hi = offsets[i], offsets[i + 1]
+        add(f"d{i}", ids[lo:hi], tfs[lo:hi], float(lengths[i]))
+    ingest_s = time.perf_counter() - t0
+    log(f"[ns] indexed {NS_DOCS} docs in {ingest_s:.1f}s "
+        f"({NS_DOCS/ingest_s:.0f} docs/s, direct arrays)")
+
+    t0 = time.perf_counter()
+    engine.commit()
+    commit_s = time.perf_counter() - t0
+    log(f"[ns] commit (COO->blocked ELL->device): {commit_s:.1f}s")
+
+    queries = make_queries(rng, NS_VOCAB, NS_BATCH * (NS_BATCHES + 1))
+    engine.search_batch(queries[:NS_BATCH], k=TOP_K)   # compile warmup
+    t0 = time.perf_counter()
+    total = 0
+    for b in range(1, NS_BATCHES + 1):
+        chunk = queries[b * NS_BATCH:(b + 1) * NS_BATCH]
+        engine.search_batch(chunk, k=TOP_K)
+        total += len(chunk)
+    qps = total / (time.perf_counter() - t0)
+    log(f"[ns] {total} queries -> {qps:.1f} q/s (batch={NS_BATCH})")
+
+    cpu = cpu_baselines(offsets, ids, tfs, lengths, queries, NS_VOCAB,
+                        n_batches=NS_CPU_BATCHES, batch=NS_CPU_BATCH,
+                        numpy_loop=False)
+    return {"qps": qps, "ingest_dps": NS_DOCS / ingest_s,
+            "commit_s": commit_s, "nnz": int(nnz), **cpu}
+
+
+# --------------------------------------------------------------------------
+# CPU baselines: scipy CSR + torch sparse CSR (strongest wins)
+# --------------------------------------------------------------------------
+
+def _impacts(offsets, ids, tfs, lengths):
+    """Precomputed per-entry BM25 impacts (generous to the baseline: the
+    device side recomputes query weighting per batch)."""
+    n_docs = offsets.shape[0] - 1
+    counts = np.diff(offsets)
+    row = np.repeat(np.arange(n_docs, dtype=np.int32), counts)
+    df = np.bincount(ids, minlength=int(ids.max()) + 1).astype(np.float32)
+    avgdl = lengths.mean()
+    k1, b = 1.2, 0.75
+    idf = np.log1p((n_docs - df + 0.5) / (df + 0.5))
+    denom = tfs + k1 * (1 - b + b * lengths[row] / avgdl)
+    return row, (idf[ids] * tfs / denom).astype(np.float32)
+
+
+def _parse_queries(queries, vocab_size):
+    """Query batch as a dense [B, V] matrix (term multiplicity weights)."""
+    B = len(queries)
+    qmat = np.zeros((B, vocab_size), np.float32)
+    for i, q in enumerate(queries):
+        for tok in q.split():
+            tid = int(tok[1:])
+            if 0 <= tid < vocab_size:
+                qmat[i, tid] += 1.0
+    return qmat
+
+
+def cpu_baselines(offsets, ids, tfs, lengths, queries, vocab_size,
+                  *, n_batches: int, batch: int,
+                  numpy_loop: bool) -> dict:
+    import scipy.sparse as sp
+
+    n_docs = offsets.shape[0] - 1
+    row, impact = _impacts(offsets, ids, tfs, lengths)
+    M = sp.csr_matrix((impact, (row, ids.astype(np.int64))),
+                      shape=(n_docs, vocab_size))
+    out: dict = {}
+
+    def timed(name, run):
+        run(queries[:batch])   # warm
+        t0 = time.perf_counter()
+        total = 0
+        for b in range(1, n_batches + 1):
+            chunk = queries[b * batch:(b + 1) * batch]
+            run(chunk)
+            total += len(chunk)
+        qps = total / (time.perf_counter() - t0)
+        log(f"[cpu] {name}: {qps:.2f} q/s (batch={batch})")
+        out[name] = qps
+
+    def scipy_run(qs):
+        qmat = _parse_queries(qs, vocab_size)
+        scores = M @ qmat.T                      # [n_docs, B] dense
+        k = min(TOP_K, n_docs - 1)
+        return np.argpartition(-scores, k, axis=0)[:k]
+
+    timed("scipy_csr_qps", scipy_run)
+
+    try:
+        import torch
+        Mt = torch.sparse_csr_tensor(
+            torch.from_numpy(M.indptr.astype(np.int64)),
+            torch.from_numpy(M.indices.astype(np.int64)),
+            torch.from_numpy(M.data),
+            size=M.shape)
+
+        def torch_run(qs):
+            qmat = torch.from_numpy(_parse_queries(qs, vocab_size))
+            scores = torch.matmul(Mt, qmat.T)
+            return torch.topk(scores, min(TOP_K, n_docs - 1), dim=0)
+
+        timed("torch_csr_qps", torch_run)
+    except Exception as e:   # torch sparse availability varies
+        log(f"[cpu] torch baseline skipped: {e!r}")
+
+    if numpy_loop:
+        def numpy_run(qs):
+            qmat = _parse_queries(qs, vocab_size)
+            contrib = impact[None, :] * qmat[:, ids]     # [B, nnz]
+            scores = np.zeros((len(qs), n_docs), np.float32)
+            for i in range(len(qs)):
+                np.add.at(scores[i], row, contrib[i])
+            return np.argpartition(-scores, TOP_K, axis=1)[:, :TOP_K]
+
+        timed("numpy_loop_qps", numpy_run)
+
+    out["best_cpu_qps"] = max(v for k, v in out.items() if k.endswith("qps"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# config 1: full text pipeline at 18k docs
+# --------------------------------------------------------------------------
+
+def bench_config1(rng) -> dict:
+    from tfidf_tpu.engine import Engine
+    from tfidf_tpu.utils.config import Config
+
+    t0 = time.perf_counter()
+    texts = make_texts(rng, C1_DOCS, C1_VOCAB, C1_AVG_LEN)
+    queries = make_queries(rng, C1_VOCAB, C1_BATCH * (C1_BATCHES + 1))
+    log(f"[c1] corpus+queries in {time.perf_counter()-t0:.1f}s")
+
+    engine = Engine(Config(query_batch=C1_BATCH))
+    # pass 1 (untimed) warms XLA compiles for these capacity buckets
     for i, text in enumerate(texts):
         engine.ingest_text(f"doc{i}", text)
     engine.commit()
-    log(f"[tpu] cold ingest+commit pass: {time.perf_counter()-t0:.2f}s")
     # pass 2 (timed): steady-state re-ingest (idempotent upserts) + commit
     t0 = time.perf_counter()
     for i, text in enumerate(texts):
         engine.ingest_text(f"doc{i}", text)
+    ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
     engine.commit()
-    index_s = time.perf_counter() - t0
-    log(f"[tpu] indexed {len(texts)} docs in {index_s:.2f}s "
-        f"({len(texts)/index_s:.0f} docs/s), nnz={engine.index.snapshot.nnz}, "
-        f"vocab={len(engine.vocab)}")
+    commit_s = time.perf_counter() - t0
+    log(f"[c1] text-indexed {C1_DOCS} docs in {ingest_s:.2f}s "
+        f"({C1_DOCS/ingest_s:.0f} docs/s), warm commit {commit_s:.2f}s")
 
-    # warmup (compile)
-    engine.search_batch(queries[:BATCH], k=TOP_K)
+    engine.search_batch(queries[:C1_BATCH], k=TOP_K)
     t0 = time.perf_counter()
     total = 0
-    for b in range(N_BATCHES):
-        chunk = queries[b * BATCH:(b + 1) * BATCH]
+    for b in range(1, C1_BATCHES + 1):
+        chunk = queries[b * C1_BATCH:(b + 1) * C1_BATCH]
         engine.search_batch(chunk, k=TOP_K)
         total += len(chunk)
     qps = total / (time.perf_counter() - t0)
-    log(f"[tpu] {total} queries -> {qps:.1f} q/s (batch={BATCH})")
-    return qps, len(texts) / index_s
+    log(f"[c1] {total} queries -> {qps:.1f} q/s (batch={C1_BATCH})")
+
+    # rebuild the same corpus as arrays for the CPU baselines
+    entries = engine.index.live_entries()
+    offsets = np.zeros(len(entries) + 1, np.int64)
+    for i, d in enumerate(entries):
+        offsets[i + 1] = offsets[i] + d.term_ids.shape[0]
+    ids = np.concatenate([d.term_ids for d in entries])
+    tfs = np.concatenate([d.tfs for d in entries])
+    lengths = np.asarray([d.length for d in entries], np.float32)
+    # queries reference t<id> names; map through the engine's vocab so the
+    # baseline sees the same ids
+    remap = {}
+    for tid in range(len(engine.vocab)):
+        term = engine.vocab.term(tid)
+        if term.startswith("t") and term[1:].isdigit():
+            remap[term] = tid
+    q_mapped = [" ".join(f"t{remap[tok]}" for tok in q.split()
+                         if tok in remap) for q in queries]
+    cpu = cpu_baselines(offsets, ids, tfs, lengths, q_mapped,
+                        len(engine.vocab) + 1,
+                        n_batches=2, batch=64, numpy_loop=True)
+    return {"qps": qps, "text_ingest_dps": C1_DOCS / ingest_s,
+            "warm_commit_s": commit_s, **cpu}
 
 
-def bench_cpu_baseline(texts: list[str], queries: list[str]) -> float:
-    """Same scoring math, vectorized numpy on host CPU."""
-    from tfidf_tpu.ops.analyzer import Analyzer
+# --------------------------------------------------------------------------
+# config 4 shape: streaming segments
+# --------------------------------------------------------------------------
 
-    analyzer = Analyzer()
-    vocab: dict[str, int] = {}
-    rows, cols, vals, lengths = [], [], [], []
-    for i, text in enumerate(texts):
-        counts = analyzer.counts(text)
-        lengths.append(float(sum(counts.values())))
-        for t, c in counts.items():
-            tid = vocab.setdefault(t, len(vocab))
-            rows.append(i)
-            cols.append(tid)
-            vals.append(float(c))
-    n_docs = len(texts)
-    V = len(vocab)
-    row = np.asarray(rows, np.int32)
-    col = np.asarray(cols, np.int32)
-    tf = np.asarray(vals, np.float32)
-    dl = np.asarray(lengths, np.float32)
-    df = np.bincount(col, minlength=V).astype(np.float32)
-    avgdl = dl.mean()
-    k1, b = 1.2, 0.75
-    idf = np.log1p((n_docs - df + 0.5) / (df + 0.5))
-    # precompute per-entry BM25 impact (generous to the baseline: the TPU
-    # side recomputes weights per query batch)
-    denom = tf + k1 * (1 - b + b * dl[row] / avgdl)
-    impact = (idf[col] * tf / denom).astype(np.float32)
+def bench_streaming(rng) -> dict:
+    from tfidf_tpu.engine import Engine
+    from tfidf_tpu.utils.config import Config
 
-    def run_batch(qs: list[str]) -> np.ndarray:
-        B = len(qs)
-        qmat = np.zeros((B, V), np.float32)
-        for i, q in enumerate(qs):
-            for t, c in analyzer.counts(q).items():
-                tid = vocab.get(t)
-                if tid is not None:
-                    qmat[i, tid] += c
-        contrib = impact[None, :] * qmat[:, col]          # [B, nnz]
-        scores = np.zeros((B, n_docs), np.float32)
-        for i in range(B):
-            np.add.at(scores[i], row, contrib[i])
-        top = np.argpartition(-scores, TOP_K, axis=1)[:, :TOP_K]
-        return top
-
-    run_batch(queries[:CPU_BATCH])   # warm caches
+    offsets, ids, tfs, lengths = make_doc_arrays(
+        rng, ST_DOCS, NS_VOCAB, ST_AVG_LEN)
+    engine = Engine(Config(index_mode="segments", query_batch=64))
+    # register only the terms that occur (segments mode needs vocab_cap)
     t0 = time.perf_counter()
-    total = 0
-    for bidx in range(CPU_BATCHES):
-        chunk = queries[bidx * CPU_BATCH:(bidx + 1) * CPU_BATCH]
-        run_batch(chunk)
-        total += len(chunk)
-    qps = total / (time.perf_counter() - t0)
-    log(f"[cpu] {total} queries -> {qps:.1f} q/s (numpy baseline)")
-    return qps
+    uniq = np.unique(ids)
+    for tid in uniq.tolist():
+        engine.vocab.add(f"t{tid}")
+    # remap corpus ids to vocab ids (dense, first-seen order = sorted here)
+    lut = np.zeros(int(uniq.max()) + 1, np.int32)
+    lut[uniq] = np.arange(uniq.shape[0], dtype=np.int32)
+    ids = lut[ids]
+    log(f"[st] vocab ({uniq.shape[0]} terms) in "
+        f"{time.perf_counter()-t0:.1f}s")
+
+    t0 = time.perf_counter()
+    add = engine.index.add_document_arrays
+    commit_ms = []
+    for i in range(ST_DOCS):
+        lo, hi = offsets[i], offsets[i + 1]
+        add(f"d{i}", ids[lo:hi], tfs[lo:hi], float(lengths[i]))
+        if (i + 1) % ST_COMMIT_EVERY == 0:
+            c0 = time.perf_counter()
+            engine.commit()
+            commit_ms.append((time.perf_counter() - c0) * 1e3)
+    total_s = time.perf_counter() - t0
+    log(f"[st] streamed {ST_DOCS} docs in {total_s:.1f}s "
+        f"({ST_DOCS/total_s:.0f} docs/s sustained, "
+        f"{len(commit_ms)} commits, last {commit_ms[-1]:.0f}ms)")
+    hits = engine.search("t17 t4242")
+    assert hits, "streaming index must answer queries"
+    return {"streaming_dps": ST_DOCS / total_s,
+            "commit_ms_first": round(commit_ms[0], 1),
+            "commit_ms_last": round(commit_ms[-1], 1),
+            "segments": len(engine.index.snapshot.segments)}
 
 
 def main() -> None:
     rng = np.random.default_rng(SEED)
-    t0 = time.perf_counter()
-    texts = make_corpus(rng)
-    queries = make_queries(rng, VOCAB, BATCH * N_BATCHES)
-    log(f"[gen] corpus+queries in {time.perf_counter()-t0:.1f}s")
-
-    tpu_qps, index_dps = bench_tpu(texts, queries)
-    cpu_qps = bench_cpu_baseline(texts, queries)
+    ns = bench_north_star(rng)
+    c1 = bench_config1(rng)
+    st = bench_streaming(rng)
 
     result = {
-        "metric": "bm25_batched_query_qps_18k_docs",
-        "value": round(tpu_qps, 2),
+        "metric": "bm25_batched_query_qps_1m_docs_500k_vocab",
+        "value": round(ns["qps"], 2),
         "unit": "queries/sec",
-        "vs_baseline": round(tpu_qps / cpu_qps, 2),
+        # denominator: the STRONGEST CPU implementation at the same
+        # 1M-doc config (scipy/torch sparse CSR over precomputed impacts)
+        "vs_baseline": round(ns["qps"] / ns["best_cpu_qps"], 2),
         "extra": {
-            "indexing_docs_per_sec": round(index_dps, 1),
-            "cpu_baseline_qps": round(cpu_qps, 2),
-            "batch": BATCH,
+            "north_star": {
+                "qps": round(ns["qps"], 2),
+                "batch": NS_BATCH,
+                "ingest_docs_per_sec": round(ns["ingest_dps"], 1),
+                "commit_s": round(ns["commit_s"], 2),
+                "nnz": ns["nnz"],
+                "scipy_csr_qps": round(ns.get("scipy_csr_qps", 0), 3),
+                "torch_csr_qps": round(ns.get("torch_csr_qps", 0), 3),
+            },
+            "config1_18k_fulltext": {
+                "qps": round(c1["qps"], 2),
+                "batch": C1_BATCH,
+                "text_ingest_docs_per_sec": round(c1["text_ingest_dps"], 1),
+                "warm_commit_s": round(c1["warm_commit_s"], 2),
+                "scipy_csr_qps": round(c1.get("scipy_csr_qps", 0), 2),
+                "torch_csr_qps": round(c1.get("torch_csr_qps", 0), 2),
+                "numpy_loop_qps": round(c1.get("numpy_loop_qps", 0), 2),
+                "vs_best_cpu": round(c1["qps"] / c1["best_cpu_qps"], 2),
+            },
+            "streaming_segments_100k": st,
             "top_k": TOP_K,
-            "n_docs": N_DOCS,
         },
     }
     print(json.dumps(result))

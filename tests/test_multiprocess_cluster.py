@@ -1,0 +1,155 @@
+"""Real multi-PROCESS cluster test (SURVEY §4's prescribed shape).
+
+The other cluster tests run nodes as threads sharing one in-process
+coordination core; this one runs the actual deployment shape: a
+standalone coordination service + three `python -m tfidf_tpu serve`
+node processes talking HTTP, exercising election, upload placement,
+scatter-gather search, and leader-kill failover across process
+boundaries — what the reference only ever validated by hand
+(TF-IDF-System-Core/README.md:96).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url: str, timeout=5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _post(url: str, data: bytes, ctype="application/octet-stream",
+          timeout=10.0) -> bytes:
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _wait(pred, timeout=30.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return True
+        except Exception as e:
+            last = e
+        time.sleep(interval)
+    raise AssertionError(f"timed out; last error: {last!r}")
+
+
+@pytest.mark.timeout(300)
+def test_three_process_cluster_with_failover(tmp_path):
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    coord_port = _free_port()
+    procs: list[subprocess.Popen] = []
+
+    def spawn(args, **env_over):
+        e = dict(env, **{k: str(v) for k, v in env_over.items()})
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tfidf_tpu", *args],
+            env=e, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        procs.append(p)
+        return p
+
+    try:
+        spawn(["coordinator", "--listen", f"127.0.0.1:{coord_port}"])
+        _wait(lambda: _get_coord_up(coord_port), timeout=60)
+
+        ports = [_free_port() for _ in range(3)]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        for i, port in enumerate(ports):
+            spawn(["serve", "--port", str(port), "--host", "127.0.0.1",
+                   "--coordinator-address", f"127.0.0.1:{coord_port}",
+                   "--documents-path", str(tmp_path / f"n{i}" / "docs"),
+                   "--index-path", str(tmp_path / f"n{i}" / "index")],
+                  TFIDF_SESSION_TIMEOUT_S="1.0",
+                  TFIDF_HEARTBEAT_INTERVAL_S="0.2")
+            # serial start -> deterministic election order (node 0 leads)
+            _wait(lambda u=urls[i]: _get(u + "/api/status"), timeout=120)
+
+        assert _get(urls[0] + "/api/status") == b"I am the leader"
+        _wait(lambda: len(json.loads(_get(urls[0] + "/api/services"))) == 2)
+
+        docs = {
+            "a.txt": b"the quick brown fox jumps over the lazy dog",
+            "b.txt": b"a fast brown fox and a quick red fox",
+            "c.txt": b"lorem ipsum dolor sit amet",
+            "d.txt": b"red dogs chase brown foxes at dawn",
+        }
+        for name, data in docs.items():
+            _post(urls[0] + f"/leader/upload?name={name}", data)
+
+        # first searches pay each worker's XLA compile, which can exceed
+        # the leader's per-worker timeout (partial results are the
+        # reference's per-worker tolerance, Leader.java:67-69) — poll
+        # until every worker answers warm
+        def full_results():
+            res = json.loads(_post(urls[0] + "/leader/start", b"brown fox",
+                                   ctype="application/json"))
+            return set(res) == {"a.txt", "b.txt", "d.txt"}
+
+        _wait(full_results, timeout=120, interval=1.0)
+
+        # download must find the doc wherever placement put it
+        got = _get(urls[0] + "/leader/download?path=c.txt")
+        assert got == docs["c.txt"]
+
+        # ---- failover: kill the leader process outright ----
+        procs[1].send_signal(signal.SIGKILL)
+
+        def promoted():
+            for u in urls[1:]:
+                if _get(u + "/api/status") == b"I am the leader":
+                    return u
+            return None
+
+        new_leader = None
+
+        def check():
+            nonlocal new_leader
+            new_leader = promoted()
+            return new_leader is not None
+
+        _wait(check, timeout=30)
+        # the promoted node still serves cluster search over the
+        # remaining worker's shard
+        res = json.loads(_post(new_leader + "/leader/start", b"fox",
+                               ctype="application/json"))
+        assert isinstance(res, dict)
+        services = json.loads(_get(new_leader + "/api/services"))
+        assert len(services) == 1
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+
+def _get_coord_up(port: int) -> bool:
+    with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+        return True

@@ -56,6 +56,10 @@ def _wait(pred, timeout=30.0, interval=0.2):
 @pytest.mark.timeout(300)
 def test_three_process_cluster_with_failover(tmp_path):
     env = os.environ.copy()
+    # TFIDF_JAX_PLATFORM (not JAX_PLATFORMS): ambient accelerator
+    # plugins can override the plain env var; the CLI-level pin cannot
+    # be (cli._apply_platform_override)
+    env["TFIDF_JAX_PLATFORM"] = "cpu"
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     coord_port = _free_port()

@@ -273,7 +273,29 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _apply_platform_override() -> None:
+    """``TFIDF_JAX_PLATFORM``: pin the JAX backend before it initializes.
+
+    Needed where the ambient environment force-registers an accelerator
+    plugin that ignores ``JAX_PLATFORMS`` (and useful generally to run
+    CPU-only control nodes next to TPU data nodes). Must run before any
+    jax backend use; a no-op once a backend exists.
+    """
+    plat = os.environ.get("TFIDF_JAX_PLATFORM")
+    if not plat:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", plat)
+        n = int(os.environ.get("TFIDF_CPU_DEVICES", "0"))
+        if plat == "cpu" and n > 0:
+            jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError as e:   # backend already initialized
+        log.warning("platform override ignored", err=str(e))
+
+
 def main(argv: list[str] | None = None) -> int:
+    _apply_platform_override()
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

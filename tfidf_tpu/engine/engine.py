@@ -98,7 +98,8 @@ class Engine:
         self.searcher = Searcher(
             self.index, self.analyzer, self.vocab, self.model,
             query_batch=c.query_batch, max_query_terms=c.max_query_terms,
-            top_k=c.top_k, result_order=c.result_order)
+            top_k=c.top_k, result_order=c.result_order,
+            use_pallas=c.use_pallas)
 
     # ---- ingest (Worker.upload / addDocToIndex analog) ----
 

@@ -40,9 +40,12 @@ class SearchHit(NamedTuple):
 
 def vectorize_queries(queries: list[str], analyzer: Analyzer,
                       vocab: Vocabulary, model: ScoringModel,
-                      *, batch_cap: int, max_terms: int) -> QueryBatch:
+                      *, batch_cap: int, max_terms: int
+                      ) -> tuple[QueryBatch, int]:
     """Analyze + pad a query batch to [batch_cap, max_terms] and dedup the
     batch's terms into a compact slot space (:class:`QueryBatch`).
+    Returns ``(batch, max distinct terms in any one query)`` — the width
+    statistic drives the Pallas query-group size.
 
     Pad entries are inert by construction in the scoring kernel. Queries
     with more than ``max_terms`` distinct terms keep the highest-weight
@@ -51,22 +54,25 @@ def vectorize_queries(queries: list[str], analyzer: Analyzer,
     assert len(queries) <= batch_cap
     q_terms = np.zeros((batch_cap, max_terms), np.int32)
     q_weights = np.zeros((batch_cap, max_terms), np.float32)
+    widest = 1
     for i, q in enumerate(queries):
         counts = vocab.map_counts(analyzer.counts(q), add=False)
         weights = model.query_weights(counts)
         items = sorted(weights.items(), key=lambda kv: (-kv[1], kv[0]))
         items = items[:max_terms]
+        widest = max(widest, len(items))
         for j, (tid, w) in enumerate(items):
             q_terms[i, j] = tid
             q_weights[i, j] = w
-    return make_query_batch(q_terms, q_weights)
+    return make_query_batch(q_terms, q_weights), widest
 
 
 class Searcher:
     def __init__(self, index: ShardIndex, analyzer: Analyzer,
                  vocab: Vocabulary, model: ScoringModel,
                  *, query_batch: int = 32, max_query_terms: int = 32,
-                 top_k: int = 10, result_order: str = "score") -> None:
+                 top_k: int = 10, result_order: str = "score",
+                 use_pallas: bool = False) -> None:
         self.index = index
         self.analyzer = analyzer
         self.vocab = vocab
@@ -77,6 +83,7 @@ class Searcher:
         # "name" reproduces the reference's alphabetical result ordering
         # (Leader.java:80-91 sorts the merged map by document name)
         self.result_order = result_order
+        self.use_pallas = use_pallas
 
     def _batch_cap(self, n: int) -> int:
         return min(self.query_batch, next_capacity(max(n, 1), 1))
@@ -105,7 +112,7 @@ class Searcher:
                       unbounded: bool) -> list[list[SearchHit]]:
         cap = self._batch_cap(len(queries))
         with trace_phase("vectorize"):
-            qb = vectorize_queries(
+            qb, widest = vectorize_queries(
                 queries, self.analyzer, self.vocab, self.model,
                 batch_cap=cap, max_terms=self.max_query_terms)
         with trace_phase("score"):
@@ -114,12 +121,14 @@ class Searcher:
                     snap.views, snap.df, qb, snap.n_docs, snap.avgdl,
                     **self.model.score_kwargs())
             elif snap.is_ell:
-                # gather/MXU fast path: impacts precomputed at commit
+                # gather fast path: impacts precomputed at commit;
+                # big blocks ride the fused compare/MXU Pallas kernel
                 scores = score_ell_batch(
                     snap.ell_impacts, snap.ell_terms, snap.ell_live,
                     snap.res_tf, snap.res_term, snap.res_doc,
                     snap.doc_len, snap.df, qb,
                     snap.n_docs, snap.avgdl, snap.doc_norms,
+                    use_pallas=self.use_pallas,
                     **self.model.score_kwargs())
             else:
                 scores = score_coo_batch(

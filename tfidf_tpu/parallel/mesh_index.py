@@ -394,7 +394,7 @@ class MeshSearcher:
         for lo in range(0, len(queries), cap):
             chunk = queries[lo:lo + cap]
             bcap = self._batch_cap(len(chunk))
-            qb = vectorize_queries(
+            qb, _widest = vectorize_queries(
                 chunk, self.analyzer, self.vocab, self.model,
                 batch_cap=bcap, max_terms=self.max_query_terms)
             if unbounded:

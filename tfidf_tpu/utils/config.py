@@ -92,6 +92,10 @@ class Config:
     #        impacts (TPU fast path). "coo": chunked scatter scoring.
     scoring_layout: str = "ell"
     ell_width_cap: int = 256   # max ELL row width; longer docs spill to COO
+    # Fused Pallas gather kernel for big ELL blocks (avoids the XLA
+    # path's [rows, width, B] HBM materialization — the gather-bound
+    # bottleneck at 1M-doc scale). Small blocks always use the XLA path.
+    use_pallas: bool = True
 
     # --- index mode ---
     # "rebuild": every commit re-lays-out the whole corpus (static corpora)

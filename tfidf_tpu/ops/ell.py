@@ -194,9 +194,13 @@ def _pallas_kernel(uniq_ref, qc_ref, term_ref, imp_ref, out_ref,
     u1 = uniq_col.shape[0]
     a = jax.lax.fori_loop(0, width, body,
                           jnp.zeros((u1, td), jnp.float32))
-    # the contraction rides the MXU: [B, U1] @ [U1, Td]
+    # the contraction rides the MXU: [B, U1] @ [U1, Td]. HIGHEST keeps
+    # f32-equivalent accumulation (the default bf16 passes cost ~0.4%
+    # relative error — enough to flip top-k near-ties); the matmul is
+    # not the kernel's bottleneck, the A build is.
     out_ref[:] = jnp.dot(qc_ref[:], a,
-                         preferred_element_type=jnp.float32)
+                         preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
 
 
 def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]

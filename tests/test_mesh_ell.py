@@ -1,0 +1,196 @@
+"""Mesh ELL layout: base+delta lifecycle on the 8-virtual-device mesh.
+
+The ELL mesh layout must be result-equivalent to both the COO mesh
+layout and the single-device engine; appends land in the COO delta
+without an O(corpus) rebuild; stats are live-corpus (so deletes tighten
+IDF immediately, matching the local rebuild engine).
+"""
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.parallel.mesh_ell_index import MeshEllIndex
+from tfidf_tpu.utils.config import Config
+
+TEXTS = {
+    "a.txt": "the quick brown fox jumps over the lazy dog",
+    "b.txt": "a fast brown fox and a quick red fox",
+    "c.txt": "lorem ipsum dolor sit amet",
+    "d.txt": "the dog sleeps all day long",
+    "e.txt": "red dogs chase brown foxes at dawn",
+    "f.txt": "ipsum lorem amet dolor",
+    "g.txt": "quick quick quick brown brown dog",
+    "h.txt": "foxes and dogs and foxes again",
+    "i.txt": "dawn chorus over the lazy meadow",
+    "j.txt": "meadow fox naps in the red dawn",
+}
+
+QUERIES = ("fox", "brown dog", "lorem ipsum", "red dawn", "meadow")
+
+
+def make_engine(tmp_path, sub, mode, **kw):
+    cfg = Config(documents_path=str(tmp_path / sub), engine_mode=mode,
+                 min_doc_capacity=8, min_nnz_capacity=256,
+                 min_vocab_capacity=64, query_batch=4, max_query_terms=8,
+                 **kw)
+    return Engine(cfg)
+
+
+def results(engine, queries=QUERIES, k=None):
+    return [sorted(((h.name, round(h.score, 4)) for h in
+                    engine.search(q, k=k)),
+                   key=lambda nv: (-nv[1], nv[0]))
+            for q in queries]
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("model", ["bm25", "tfidf"])
+    def test_ell_mesh_equals_local(self, tmp_path, model):
+        mesh = make_engine(tmp_path, "m", "mesh", model=model)
+        local = make_engine(tmp_path, "l", "local", model=model)
+        assert isinstance(mesh.index, MeshEllIndex)
+        for e in (mesh, local):
+            for name, text in TEXTS.items():
+                e.ingest_text(name, text)
+            e.commit()
+        assert results(mesh) == results(local)
+
+    def test_cosine_falls_back_to_coo(self, tmp_path):
+        e = make_engine(tmp_path, "cf", "mesh", model="tfidf_cosine")
+        assert not isinstance(e.index, MeshEllIndex)
+
+    def test_parity_falls_back_to_coo(self, tmp_path):
+        e = make_engine(tmp_path, "pf", "mesh", lucene_parity=True)
+        assert not isinstance(e.index, MeshEllIndex)
+
+    def test_delta_append_equals_local(self, tmp_path):
+        """Appends after the initial build go to the COO delta and score
+        identically to a local engine holding everything."""
+        mesh = make_engine(tmp_path, "md", "mesh")
+        local = make_engine(tmp_path, "ld", "local")
+        items = list(TEXTS.items())
+        for name, text in items:
+            local.ingest_text(name, text)
+        local.commit()
+        for name, text in items[:8]:
+            mesh.ingest_text(name, text)
+        mesh.commit()          # base: 8 docs
+        for name, text in items[8:]:
+            mesh.ingest_text(name, text)
+        mesh.commit()          # delta: 2 docs (below rebuild fraction)
+        assert mesh.index.appends >= 1
+        snap = mesh.index.snapshot
+        assert snap.total_live == len(items)
+        assert int(np.asarray(snap.delta.n_live).sum()) == 2
+        assert results(mesh) == results(local)
+
+    def test_stats_refresh_covers_delta(self, tmp_path):
+        """df/N/avgdl include delta docs, and base impacts are refreshed
+        — a doc in the base must see its score change when delta docs
+        shift the global df."""
+        e = make_engine(tmp_path, "sr", "mesh")
+        e.ingest_text("a.txt", "rare shared")
+        e.ingest_text("pad1.txt", "filler words only here")
+        e.ingest_text("pad2.txt", "other filler words again")
+        e.ingest_text("pad3.txt", "more padding text")
+        e.ingest_text("pad4.txt", "yet more padding")
+        e.ingest_text("pad5.txt", "final pad file")
+        e.commit()
+        s1 = {h.name: h.score for h in e.search("shared")}
+        e.ingest_text("x.txt", "shared appears again")   # delta append
+        e.commit()
+        assert e.index.appends >= 1 or e.index.rebuilds >= 2
+        s2 = {h.name: h.score for h in e.search("shared")}
+        assert abs(s1["a.txt"] - s2["a.txt"]) > 1e-6
+
+
+class TestLifecycle:
+    def test_delete_in_base_and_delta(self, tmp_path):
+        e = make_engine(tmp_path, "del", "mesh")
+        items = list(TEXTS.items())
+        for name, text in items[:8]:
+            e.ingest_text(name, text)
+        e.commit()
+        for name, text in items[8:]:
+            e.ingest_text(name, text)
+        e.commit()
+        # b.txt lives in the base, j.txt in the delta
+        assert e.delete("b.txt")
+        assert e.delete("j.txt")
+        e.commit()
+        names = [h.name for h in e.search("fox", k=10)]
+        assert "b.txt" not in names and "j.txt" not in names
+        assert "a.txt" in names
+        # live-corpus stats: the delete changed df -> scores match a
+        # local engine over the surviving docs
+        local = make_engine(tmp_path, "dl", "local")
+        for name, text in items:
+            if name not in ("b.txt", "j.txt"):
+                local.ingest_text(name, text)
+        local.commit()
+        assert results(e) == results(local)
+
+    def test_upsert_moves_doc_to_delta(self, tmp_path):
+        e = make_engine(tmp_path, "up", "mesh")
+        for name, text in TEXTS.items():
+            e.ingest_text(name, text)
+        e.commit()
+        e.ingest_text("a.txt", "replacement narwhal content")
+        e.commit()
+        assert [h.name for h in e.search("narwhal")] == ["a.txt"]
+        assert "a.txt" not in [h.name for h in e.search("quick", k=10)]
+        assert e.index.num_live_docs == len(TEXTS)
+
+    def test_delta_growth_triggers_fold(self, tmp_path):
+        e = make_engine(tmp_path, "fold", "mesh")
+        e.ingest_text("seed.txt", "alpha beta")
+        e.commit()
+        r0 = e.index.rebuilds
+        for i in range(30):     # far beyond delta_rebuild_frac
+            e.ingest_text(f"d{i}.txt", f"alpha token{i % 7}")
+            e.commit()
+        assert e.index.rebuilds > r0
+        assert e.index.num_live_docs == 31
+        hits = e.search("token3", k=10)
+        assert len(hits) == 4   # i in {3, 10, 17, 24} within range(30)
+
+    def test_vocab_growth_reshards(self, tmp_path):
+        e = make_engine(tmp_path, "vg", "mesh")
+        for name, text in list(TEXTS.items())[:4]:
+            e.ingest_text(name, text)
+        e.commit()
+        r0 = e.index.rebuilds
+        for i in range(4):
+            e.ingest_text(f"v{i}.txt",
+                          " ".join(f"neo{i}_{j}" for j in range(40)))
+        e.commit()
+        assert e.index.rebuilds > r0
+        assert [h.name for h in e.search("neo2_7")] == ["v2.txt"]
+        assert "a.txt" in [h.name for h in e.search("fox", k=10)]
+
+    def test_wide_doc_spills_to_residual(self, tmp_path):
+        e = make_engine(tmp_path, "wide", "mesh", ell_width_cap=16)
+        local = make_engine(tmp_path, "widel", "local", ell_width_cap=16)
+        wide = " ".join(f"w{i:03d}" for i in range(100))
+        for eng in (e, local):
+            eng.ingest_text("wide.txt", wide)
+            eng.ingest_text("a.txt", "w001 w002 and more")
+            eng.commit()
+        qs = ("w001", "w050 w099")
+        assert results(e, qs) == results(local, qs)
+
+    def test_name_mapping_through_permutation(self, tmp_path):
+        """ELL rows are width-sorted (a permutation of insertion order):
+        every doc must come back under its own name."""
+        e = make_engine(tmp_path, "perm", "mesh")
+        rng = np.random.default_rng(3)
+        for i in range(24):
+            n = int(rng.integers(1, 30))
+            e.ingest_text(f"p{i:02d}.txt",
+                          " ".join(f"u{i:02d}" for _ in range(n))
+                          + f" mark{i:02d}")
+        e.commit()
+        for i in range(24):
+            assert [h.name for h in e.search(f"mark{i:02d}")] == \
+                [f"p{i:02d}.txt"], i

@@ -28,6 +28,10 @@ QUERIES = ("fox", "brown dog", "lorem ipsum", "red dawn", "meadow")
 
 
 def make_engine(tmp_path, sub, mode, **kw):
+    # these tests cover the COO mesh layout's internals (snapshot.arrays,
+    # ShardedArrays lifecycle); the ELL layout has its own suite in
+    # test_mesh_ell.py
+    kw.setdefault("mesh_layout", "coo")
     cfg = Config(documents_path=str(tmp_path / sub), engine_mode=mode,
                  min_doc_capacity=8, min_nnz_capacity=256,
                  min_vocab_capacity=64, query_batch=4, max_query_terms=8,

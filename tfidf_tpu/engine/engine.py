@@ -68,11 +68,33 @@ class Engine:
                 shape = tuple(c.mesh_shape) if c.mesh_shape else None
                 mesh = make_mesh(shape)
             d_x_t = mesh.shape["docs"] * mesh.shape["terms"]
+            min_chunk = max(1 << 10, c.min_nnz_capacity // max(1, d_x_t))
+            # the ELL base layout cannot express cosine norms, per-shard
+            # parity statistics, or unbounded ranking — those configs
+            # keep the COO scatter layout
+            want_ell = (c.mesh_layout == "ell"
+                        and not self.model.needs_norms
+                        and not c.lucene_parity
+                        and not c.unbounded_results
+                        and mesh.shape["terms"] <= 8)
+            if want_ell:
+                from tfidf_tpu.parallel.mesh_ell_index import (
+                    MeshEllIndex, MeshEllSearcher)
+                self.index = MeshEllIndex(
+                    self.model, mesh=mesh,
+                    min_doc_cap=c.min_doc_capacity,
+                    min_chunk_cap=min_chunk,
+                    ell_width_cap=c.ell_width_cap)
+                self.searcher = MeshEllSearcher(
+                    self.index, self.analyzer, self.vocab, self.model,
+                    query_batch=c.query_batch,
+                    max_query_terms=c.max_query_terms,
+                    top_k=c.top_k, result_order=c.result_order)
+                return
             self.index = MeshIndex(
                 self.model, mesh=mesh,
                 min_doc_cap=c.min_doc_capacity,
-                min_chunk_cap=max(1 << 10,
-                                  c.min_nnz_capacity // max(1, d_x_t)))
+                min_chunk_cap=min_chunk)
             self.searcher = MeshSearcher(
                 self.index, self.analyzer, self.vocab, self.model,
                 query_batch=c.query_batch,

@@ -1,0 +1,349 @@
+"""MeshEllIndex / MeshEllSearcher — ELL-base + COO-delta mesh serving.
+
+The fast mesh layout (:mod:`tfidf_tpu.parallel.mesh_ell`): committed
+documents live in a blocked-ELL base scored by the compare/MXU kernel;
+appends land in a COO delta (the plain :class:`ShardedArrays` machinery)
+and are folded into the base at the next re-shard — Lucene's
+segments-then-merge shape at mesh scale. Global statistics (df, N,
+avgdl) are recomputed over the LIVE corpus at every commit and pushed
+replicated to the mesh, and base impacts are refreshed from them
+on-device, so scores always reflect current stats (the streaming-segment
+contract) and — unlike the COO path, which keeps tombstones in df until
+a re-shard — match the single-device rebuild engine exactly.
+
+Not supported here (Engine falls back to the COO mesh layout):
+``tfidf_cosine`` (norms per doc per commit) and Lucene local-stats
+parity / unbounded results (parity is a correctness mode; it keeps the
+scatter path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tfidf_tpu.ops.csr import CooShard, next_capacity
+from tfidf_tpu.parallel.mesh_ell import (MeshEllArrays, build_mesh_ell,
+                                         make_impact_refresh,
+                                         make_mesh_ell_search,
+                                         with_ell_live)
+from tfidf_tpu.parallel.mesh_index import MeshIndex, MeshSearcher
+from tfidf_tpu.parallel.sharded import (ShardedArrays,
+                                        build_sharded_arrays,
+                                        with_live_mask)
+from tfidf_tpu.utils.logging import get_logger
+from tfidf_tpu.utils.metrics import global_metrics
+
+log = get_logger("parallel.mesh_ell_index")
+
+
+class MeshEllSnapshot:
+    """Published state: ELL base + COO delta + current global stats."""
+
+    def __init__(self, *, base: MeshEllArrays, delta: ShardedArrays,
+                 perms, base_counts, shard_docs, df_g, n_docs, avgdl,
+                 version, nnz, total_live) -> None:
+        self.base = base
+        self.delta = delta
+        self.perms = perms                 # per shard: ell_row -> ins id
+        self.base_counts = base_counts     # docs in base per shard
+        self.shard_docs = shard_docs
+        self.df_g = df_g                   # f32 [vocab_cap] replicated
+        self.n_docs = n_docs               # f32 scalar (LIVE count)
+        self.avgdl = avgdl
+        self.version = version
+        self.nnz = nnz
+        self.total_live = total_live
+
+    @property
+    def stride(self) -> int:
+        return self.base.doc_cap + self.delta.doc_cap
+
+    def name_of(self, gid: int) -> str | None:
+        s, local = divmod(gid, self.stride)
+        if s >= len(self.shard_docs):
+            return None
+        sd = self.shard_docs[s]
+        if local < self.base.doc_cap:      # ELL row -> permuted ins id
+            perm = self.perms[s]
+            if local >= perm.shape[0]:
+                return None
+            return sd[int(perm[local])].name
+        delta_local = local - self.base.doc_cap
+        ins = self.base_counts[s] + delta_local
+        return sd[ins].name if ins < len(sd) else None
+
+
+class MeshEllIndex(MeshIndex):
+    """MeshIndex whose committed base is blocked ELL (the fast layout)."""
+
+    def __init__(self, model, mesh=None, min_doc_cap: int = 1024,
+                 min_chunk_cap: int = 1 << 14,
+                 ell_width_cap: int = 256,
+                 delta_rebuild_frac: float = 0.5) -> None:
+        super().__init__(model, mesh=mesh, min_doc_cap=min_doc_cap,
+                         min_chunk_cap=min_chunk_cap)
+        self.ell_width_cap = ell_width_cap
+        # fold the delta into the base when it exceeds this fraction of
+        # the corpus (the merge policy)
+        self.delta_rebuild_frac = delta_rebuild_frac
+        self._base: MeshEllArrays | None = None
+        self._perms: list[np.ndarray] = []
+        self._base_counts: list[int] = []
+        self._refresh_fn = None
+
+    # ---- commit ----
+
+    def commit(self, vocab_cap: int):
+        with self._write_lock:
+            gen0 = self._gen
+            if (self._committed_gen == gen0 and self.snapshot is not None
+                    and self.snapshot.df_g.shape[0] >= vocab_cap):
+                return self.snapshot
+            pending = list(self._pending.values())
+            delta = self.snapshot.delta if self.snapshot else None
+            need_rebuild = (
+                self._base is None
+                or vocab_cap > self.snapshot.df_g.shape[0]
+                or self._delta_too_big(pending))
+            if need_rebuild:
+                self._rebuild_ell_locked(pending, vocab_cap)
+                delta = self._empty_delta(vocab_cap)
+            elif pending:
+                try:
+                    delta = self._append_locked(delta, pending)
+                except ValueError as e:
+                    log.info("delta overflow; folding into ELL base",
+                             reason=str(e).split(";")[0])
+                    self._rebuild_ell_locked(pending, vocab_cap)
+                    delta = self._empty_delta(vocab_cap)
+            self._pending = {}
+
+            # live-corpus global stats, recomputed host-side (appends
+            # and deletes both move them; the base impacts are refreshed
+            # below so IDF never goes stale)
+            df_host, n_live, len_sum = self._live_stats(vocab_cap)
+            df_g = jax.device_put(
+                df_host, NamedSharding(self.mesh, P(None)))
+            n_docs = jnp.float32(n_live)
+            avgdl = jnp.float32(len_sum / n_live if n_live else 1.0)
+            if self._refresh_fn is None:
+                kw = self.model.score_kwargs()
+                self._refresh_fn = make_impact_refresh(
+                    self.mesh, model=kw["model"], k1=kw.get("k1", 1.2),
+                    b=kw.get("b", 0.75))
+            base = self._refresh_fn(self._base, df_g, n_docs, avgdl)
+            base = with_ell_live(self.mesh, base, self._ell_mask(base))
+            self._base = base
+            if self._mask_dirty:
+                delta = with_live_mask(self.mesh, delta,
+                                       self._delta_mask(delta.doc_cap))
+                self._mask_dirty = False
+            self._version += 1
+            snap = MeshEllSnapshot(
+                base=base, delta=delta, perms=self._perms,
+                base_counts=list(self._base_counts),
+                shard_docs=self._shard_docs,
+                df_g=df_g, n_docs=n_docs, avgdl=avgdl,
+                version=self._version, nnz=self.nnz_live,
+                total_live=len(self._placed))
+            self.snapshot = snap
+            self._committed_gen = gen0
+        global_metrics.set_gauge("index_docs", snap.total_live)
+        global_metrics.set_gauge("index_nnz", snap.nnz)
+        log.info("committed mesh-ell snapshot", version=snap.version,
+                 docs=snap.total_live, nnz=snap.nnz,
+                 mesh=dict(self.mesh.shape))
+        return snap
+
+    def _delta_too_big(self, pending) -> bool:
+        base_docs = sum(self._base_counts)
+        delta_docs = (len(self._placed) + len(pending)) - base_docs
+        return (base_docs == 0
+                or delta_docs > self.delta_rebuild_frac * base_docs)
+
+    def _live_stats(self, vocab_cap: int):
+        ids = []
+        n = 0
+        len_sum = 0.0
+        for sd in self._shard_docs:
+            for d in sd:
+                if d.live:
+                    ids.append(d.term_ids)
+                    n += 1
+                    len_sum += d.length
+        if ids:
+            allids = np.concatenate(ids)
+            df = np.bincount(allids, minlength=vocab_cap)[:vocab_cap]
+            df = df.astype(np.float32)
+        else:
+            df = np.zeros(vocab_cap, np.float32)
+        return df, n, len_sum
+
+    def _rebuild_ell_locked(self, pending, vocab_cap: int) -> None:
+        """Fold everything (base + delta + pending) into a fresh ELL
+        base with round-robin placement; drops tombstones."""
+        entries = []
+        for sd in self._shard_docs:
+            entries.extend(d for d in sd if d.live)
+        entries.extend(pending)
+        per_shard = [[] for _ in range(self.D)]
+        self._shard_docs = [[] for _ in range(self.D)]
+        self._placed = {}
+        for i, e in enumerate(entries):
+            e.live = True
+            s = i % self.D
+            self._placed[e.name] = (s, len(self._shard_docs[s]))
+            self._shard_docs[s].append(e)
+            per_shard[s].append(e)
+        base, perms = build_mesh_ell(
+            per_shard, self.mesh, self.model.transform_doc_len,
+            width_cap=self.ell_width_cap,
+            min_rows=min(256, self.min_doc_cap))
+        self._base = base
+        self._perms = perms
+        self._base_counts = [len(p) for p in per_shard]
+        self._mask_dirty = False
+        self.rebuilds += 1
+        global_metrics.inc("mesh_reshards")
+
+    def _empty_delta(self, vocab_cap: int) -> ShardedArrays:
+        coo = CooShard(
+            tf=np.zeros(0, np.float32), term=np.zeros(0, np.int32),
+            doc=np.zeros(0, np.int32),
+            doc_len=np.zeros(0, np.float32),
+            df=np.zeros(vocab_cap, np.float32), nnz=0, num_docs=0)
+        return build_sharded_arrays(
+            coo, self.mesh, min_chunk_cap=self.min_chunk_cap,
+            min_doc_cap=min(256, self.min_doc_cap))
+
+    def _append_locked(self, delta: ShardedArrays,
+                       pending) -> ShardedArrays:
+        """Append into the COO delta. Placement slots continue after the
+        base: insertion-local id = base_count + delta slot."""
+        # reuse the parent's machinery; it reads/updates _shard_docs and
+        # _placed with insertion-local ids, and build_ingest_batch's
+        # local ids continue from delta.n_live — these agree because
+        # delta slot = insertion id - base_count (appends only)
+        loads = [sum(d.term_ids.nbytes + d.tfs.nbytes
+                     for d in sd if d.live) for sd in self._shard_docs]
+        slots = [len(sd) - bc for sd, bc in
+                 zip(self._shard_docs, self._base_counts)]
+        per_entries = [[] for _ in range(self.D)]
+        for e in pending:
+            s = int(np.argmin(loads))
+            per_entries[s].append(e)
+            loads[s] += e.term_ids.nbytes + e.tfs.nbytes
+            slots[s] += 1
+            if slots[s] > delta.doc_cap:
+                raise ValueError("delta over doc capacity; re-shard")
+        from tfidf_tpu.parallel.sharded import (build_ingest_batch,
+                                                make_sharded_ingest)
+        per_docs = [[dict(zip(e.term_ids.tolist(),
+                              e.tfs.astype(np.float64).tolist()))
+                     for e in es] for es in per_entries]
+        per_lens = [
+            list(self.model.transform_doc_len(
+                np.asarray([e.length for e in es], np.float32))
+                .astype(np.float32)) if es else []
+            for es in per_entries]
+        per_raw = [[e.length for e in es] for es in per_entries]
+        max_entries = max((sum(e.term_ids.shape[0] for e in es)
+                           for es in per_entries), default=0)
+        C = next_capacity(max(-(-max_entries // self.T), 1), 64)
+        batch = build_ingest_batch(self.mesh, delta, per_docs, per_lens,
+                                   C, raw_lengths_per_shard=per_raw)
+        if self._ingest_fn is None:
+            make = make_sharded_ingest
+            self._ingest_fn = make(self.mesh)
+        delta = self._ingest_fn(delta, *batch)
+        for s, es in enumerate(per_entries):
+            for e in es:
+                self._placed[e.name] = (s, len(self._shard_docs[s]))
+                self._shard_docs[s].append(e)
+        self.appends += 1
+        global_metrics.inc("mesh_appends")
+        return delta
+
+    # ---- masks ----
+
+    def _ell_mask(self, base: MeshEllArrays) -> np.ndarray:
+        mask = np.zeros((self.D, base.doc_cap), np.float32)
+        for s, (perm, bc) in enumerate(zip(self._perms,
+                                           self._base_counts)):
+            sd = self._shard_docs[s]
+            for ell_row in range(perm.shape[0]):
+                if sd[int(perm[ell_row])].live:
+                    mask[s, ell_row] = 1.0
+        return mask
+
+    def _delta_mask(self, doc_cap: int) -> np.ndarray:
+        mask = np.zeros((self.D, doc_cap), np.float32)
+        for s, bc in enumerate(self._base_counts):
+            sd = self._shard_docs[s]
+            for ins in range(bc, len(sd)):
+                if sd[ins].live:
+                    mask[s, ins - bc] = 1.0
+        return mask
+
+    def doc_name(self, gid: int) -> str:
+        assert self.snapshot is not None
+        name = self.snapshot.name_of(int(gid))
+        assert name is not None, gid
+        return name
+
+
+class MeshEllSearcher(MeshSearcher):
+    """MeshSearcher over the ELL base + delta snapshot."""
+
+    def _get_search_fn(self, k: int):
+        fn = self._search_fns.get(k)
+        if fn is None:
+            fn = make_mesh_ell_search(
+                self.index.mesh, k=k,
+                model=self.model.score_kwargs()["model"],
+                **self._model_kwargs())
+            self._search_fns[k] = fn
+        return fn
+
+    def search(self, queries, k=None, *, unbounded: bool = False):
+        from tfidf_tpu.engine.searcher import SearchHit, vectorize_queries
+        from tfidf_tpu.ops.csr import next_capacity as ncap
+
+        if unbounded:
+            raise NotImplementedError(
+                "unbounded (parity) results need mesh_layout='coo' — "
+                "Engine selects it automatically for parity configs")
+        snap = self.index.snapshot
+        if snap is None or snap.total_live == 0:
+            return [[] for _ in queries]
+        k = self.top_k if k is None else k
+        out = []
+        cap = self._batch_cap(len(queries))
+        for lo in range(0, len(queries), cap):
+            chunk = queries[lo:lo + cap]
+            bcap = self._batch_cap(len(chunk))
+            qb, _ = vectorize_queries(
+                chunk, self.analyzer, self.vocab, self.model,
+                batch_cap=bcap, max_terms=self.max_query_terms)
+            kk = min(k, snap.stride)
+            vals_d, gids_d = self._get_search_fn(kk)(
+                snap.base, snap.delta, snap.df_g, snap.n_docs,
+                snap.avgdl, qb)
+            vals, gids = np.asarray(vals_d), np.asarray(gids_d)
+            for i in range(len(chunk)):
+                hits = []
+                for v, g in zip(vals[i, :kk], gids[i, :kk]):
+                    if not (np.isfinite(v) and v > 0.0):
+                        continue
+                    name = snap.name_of(int(g))
+                    if name is not None:
+                        hits.append(SearchHit(name, float(v)))
+                if self.result_order == "name":
+                    hits.sort(key=lambda h: h.name)
+                out.append(hits)
+        global_metrics.inc("queries_served", len(queries))
+        return out

@@ -69,6 +69,12 @@ class Config:
     #          (psum global IDF + all_gather top-k) — the serving path
     #          that subsumes the reference's whole worker pool.
     engine_mode: str = "local"         # "local" | "mesh"
+    # Mesh index layout: "ell" = blocked-ELL base scored by the
+    # compare/MXU kernel + COO append delta (the fast path); "coo" =
+    # pure COO scatter scoring (also auto-selected for tfidf_cosine,
+    # Lucene parity, and unbounded-results configs, which ELL does not
+    # support).
+    mesh_layout: str = "ell"           # "ell" | "coo"
     mesh_shape: tuple[int, ...] = ()   # () = all local devices on one "docs" axis
     mesh_axes: tuple[str, ...] = ("docs", "terms")
     # Multi-host bootstrap (jax.distributed over DCN). On TPU pods the

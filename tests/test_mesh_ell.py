@@ -194,3 +194,54 @@ class TestLifecycle:
         for i in range(24):
             assert [h.name for h in e.search(f"mark{i:02d}")] == \
                 [f"p{i:02d}.txt"], i
+
+
+class TestIncrementalStats:
+    """Incremental df/N/avgdl must equal a from-scratch recompute after
+    any mix of adds, upserts (base/delta/pending), and deletes."""
+
+    def _check(self, e):
+        cap = e.vocab.capacity()
+        inc = e.index._live_stats(cap)
+        scr = e.index._live_stats_scratch(cap)
+        assert inc[1] == scr[1], "live count"
+        assert abs(inc[2] - scr[2]) < 1e-6, "length sum"
+        np.testing.assert_array_equal(inc[0], scr[0])
+
+    def test_stats_track_mutations(self, tmp_path):
+        e = make_engine(tmp_path, "inc", "mesh")
+        for name, text in list(TEXTS.items())[:6]:
+            e.ingest_text(name, text)
+        self._check(e)
+        e.commit()
+        self._check(e)
+        # delta appends
+        for name, text in list(TEXTS.items())[6:]:
+            e.ingest_text(name, text)
+        e.commit()
+        self._check(e)
+        # upsert pending, base, and delta docs
+        e.ingest_text("zz.txt", "pending upsert one")
+        e.ingest_text("zz.txt", "pending upsert two rewritten")
+        self._check(e)
+        e.ingest_text("a.txt", "base upsert content")       # base doc
+        e.ingest_text("j.txt", "delta upsert content")      # delta doc
+        self._check(e)
+        e.commit()
+        self._check(e)
+        # deletes across all regions
+        e.delete("b.txt")
+        e.delete("zz.txt")
+        assert not e.delete("nope.txt")
+        self._check(e)
+        e.commit()
+        self._check(e)
+        # equivalence with a local engine over the same surviving docs
+        local = make_engine(tmp_path, "incl", "local")
+        survivors = {n: t for n, t in TEXTS.items() if n != "b.txt"}
+        survivors["a.txt"] = "base upsert content"
+        survivors["j.txt"] = "delta upsert content"
+        for n, t in survivors.items():
+            local.ingest_text(n, t)
+        local.commit()
+        assert results(e) == results(local)

@@ -194,7 +194,7 @@ class TestCheckpoint:
         save_sharded_arrays(arrays, path)
         restored = load_sharded_arrays(path, e.index.mesh)
         for f in ("tf", "term", "doc", "doc_len", "df", "n_live",
-                  "nnz_used", "live"):
+                  "nnz_used", "live", "len_sum"):
             assert (np.asarray(getattr(restored, f))
                     == np.asarray(getattr(arrays, f))).all(), f
         # restored arrays serve searches directly

@@ -94,6 +94,72 @@ class MeshEllIndex(MeshIndex):
         self._perms: list[np.ndarray] = []
         self._base_counts: list[int] = []
         self._refresh_fn = None
+        # incremental live-corpus stats, maintained on every mutation so
+        # commit is O(batch) host-side (full recompute only on rebuild)
+        self._df_live = np.zeros(0, np.float64)
+        self._n_live_stat = 0
+        self._len_sum_stat = 0.0
+
+    # ---- incremental stats bookkeeping ----
+
+    def _stat_add(self, entry) -> None:
+        ids = entry.term_ids
+        if ids.shape[0]:
+            hi = int(ids.max()) + 1
+            if hi > self._df_live.shape[0]:
+                grown = np.zeros(max(hi, 2 * self._df_live.shape[0]),
+                                 np.float64)
+                grown[:self._df_live.shape[0]] = self._df_live
+                self._df_live = grown
+            np.add.at(self._df_live, ids, 1.0)
+        self._n_live_stat += 1
+        self._len_sum_stat += entry.length
+
+    def _stat_remove(self, entry) -> None:
+        ids = entry.term_ids
+        if ids.shape[0]:
+            np.add.at(self._df_live, ids, -1.0)
+        self._n_live_stat -= 1
+        self._len_sum_stat -= entry.length
+
+    def add_document_arrays(self, name, ids, tfs, length=None):
+        from tfidf_tpu.engine.index import DocEntry
+        tfs = np.asarray(tfs, np.float32)
+        entry = DocEntry(
+            name=name, term_ids=np.asarray(ids, np.int32), tfs=tfs,
+            length=float(length if length is not None else tfs.sum()))
+        with self._write_lock:
+            old = self._pending.get(name)
+            if old is not None:
+                self._stat_remove(old)       # replaced in place
+            else:
+                placed = self._placed.pop(name, None)
+                if placed is not None:       # upsert: tombstone old copy
+                    s, local = placed
+                    self._shard_docs[s][local].live = False
+                    self._stat_remove(self._shard_docs[s][local])
+                    self._mask_dirty = True
+            self._pending[name] = entry
+            self._stat_add(entry)
+            self._gen += 1
+        global_metrics.inc("docs_indexed")
+
+    def delete_document(self, name: str) -> bool:
+        with self._write_lock:
+            entry = self._pending.pop(name, None)
+            if entry is not None:
+                self._stat_remove(entry)
+                self._gen += 1
+                return True
+            placed = self._placed.pop(name, None)
+            if placed is None:
+                return False
+            s, local = placed
+            self._shard_docs[s][local].live = False
+            self._stat_remove(self._shard_docs[s][local])
+            self._mask_dirty = True
+            self._gen += 1
+            return True
 
     # ---- commit ----
 
@@ -166,6 +232,19 @@ class MeshEllIndex(MeshIndex):
                 or delta_docs > self.delta_rebuild_frac * base_docs)
 
     def _live_stats(self, vocab_cap: int):
+        """O(vocab) snapshot of the incrementally-maintained live stats
+        (df counts are integers, so the float64 accumulators are exact;
+        rebuilds resync from scratch as a belt)."""
+        df = np.zeros(vocab_cap, np.float32)
+        n = min(self._df_live.shape[0], vocab_cap)
+        df[:n] = self._df_live[:n]
+        return df, self._n_live_stat, self._len_sum_stat
+
+    def _live_stats_scratch(self, vocab_cap: int,
+                            include_pending: bool = True):
+        """Full recompute over live postings (rebuild resync + tests).
+        ``include_pending=False`` when pending was already merged into
+        the shard lists (mid-rebuild)."""
         ids = []
         n = 0
         len_sum = 0.0
@@ -175,6 +254,11 @@ class MeshEllIndex(MeshIndex):
                     ids.append(d.term_ids)
                     n += 1
                     len_sum += d.length
+        if include_pending:
+            for d in self._pending.values():
+                ids.append(d.term_ids)
+                n += 1
+                len_sum += d.length
         if ids:
             allids = np.concatenate(ids)
             df = np.bincount(allids, minlength=vocab_cap)[:vocab_cap]
@@ -207,6 +291,14 @@ class MeshEllIndex(MeshIndex):
         self._perms = perms
         self._base_counts = [len(p) for p in per_shard]
         self._mask_dirty = False
+        # resync the incremental stats from the authoritative postings
+        # (pending was just merged into the shard lists above)
+        df, n, len_sum = self._live_stats_scratch(
+            max(vocab_cap, self._df_live.shape[0], 1),
+            include_pending=False)
+        self._df_live = df.astype(np.float64)
+        self._n_live_stat = n
+        self._len_sum_stat = len_sum
         self.rebuilds += 1
         global_metrics.inc("mesh_reshards")
 

@@ -39,8 +39,8 @@ class SearchHit(NamedTuple):
 
 def vectorize_queries(queries: list[str], analyzer: Analyzer,
                       vocab: Vocabulary, model: ScoringModel,
-                      *, batch_cap: int, max_terms: int
-                      ) -> tuple[QueryBatch, int]:
+                      *, batch_cap: int, max_terms: int,
+                      min_slots: int = 256) -> tuple[QueryBatch, int]:
     """Analyze + pad a query batch to [batch_cap, max_terms] and dedup the
     batch's terms into a compact slot space (:class:`QueryBatch`).
     Returns ``(batch, max distinct terms in any one query)`` — the width
@@ -48,7 +48,10 @@ def vectorize_queries(queries: list[str], analyzer: Analyzer,
 
     Pad entries are inert by construction in the scoring kernel. Queries
     with more than ``max_terms`` distinct terms keep the highest-weight
-    terms.
+    terms. ``min_slots`` floors the unique-term capacity: searchers pass
+    their high-water mark so successive batches reuse ONE compiled
+    program instead of recompiling whenever the unique count crosses a
+    power-of-two bucket (capacity padding is free in the u-tiled kernel).
     """
     assert len(queries) <= batch_cap
     q_terms = np.zeros((batch_cap, max_terms), np.int32)
@@ -63,10 +66,30 @@ def vectorize_queries(queries: list[str], analyzer: Analyzer,
         for j, (tid, w) in enumerate(items):
             q_terms[i, j] = tid
             q_weights[i, j] = w
-    return make_query_batch(q_terms, q_weights), widest
+    return make_query_batch(q_terms, q_weights,
+                            min_slots=min_slots), widest
 
 
-class Searcher:
+class QueryVectorizerMixin:
+    """The unique-term capacity high-water policy, shared by every
+    searcher family (local, COO mesh, ELL mesh): batches are vectorized
+    with ``min_slots`` floored at the largest u_cap seen so far, so the
+    compiled scoring program stays stable across query batches instead
+    of recompiling whenever the unique count crosses a power-of-two
+    bucket. Hosts must provide analyzer/vocab/model/max_query_terms."""
+
+    _u_floor = 256
+
+    def _vectorize(self, queries, cap):
+        qb, widest = vectorize_queries(
+            queries, self.analyzer, self.vocab, self.model,
+            batch_cap=cap, max_terms=self.max_query_terms,
+            min_slots=self._u_floor)
+        self._u_floor = max(self._u_floor, qb.uniq.shape[0])
+        return qb, widest
+
+
+class Searcher(QueryVectorizerMixin):
     def __init__(self, index: ShardIndex, analyzer: Analyzer,
                  vocab: Vocabulary, model: ScoringModel,
                  *, query_batch: int = 32, max_query_terms: int = 32,
@@ -111,9 +134,7 @@ class Searcher:
                       unbounded: bool) -> list[list[SearchHit]]:
         cap = self._batch_cap(len(queries))
         with trace_phase("vectorize"):
-            qb, widest = vectorize_queries(
-                queries, self.analyzer, self.vocab, self.model,
-                batch_cap=cap, max_terms=self.max_query_terms)
+            qb, widest = self._vectorize(queries, cap)
         with trace_phase("score"):
             if isinstance(snap, SegmentedSnapshot):
                 scores = score_segments_batch(

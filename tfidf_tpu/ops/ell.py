@@ -34,6 +34,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from tfidf_tpu.ops.csr import CooShard, next_capacity
 from tfidf_tpu.ops.scoring import (QueryBatch, _compile_queries,
@@ -171,36 +173,60 @@ ell_impacts = jax.jit(ell_impacts, static_argnames=("model", "k1", "b"))
 # the MXU. Everything lives in VMEM per tile; HBM traffic is postings in
 # (8 bytes/entry) and scores out.
 #
-# Cost model per batch: nnz_padded * U1 compare/селect lane-ops for A
-# plus 2*B*U1*rows MXU flops — vs the gather path's nnz_padded * B slow
-# gathers. Wins whenever U1 (unique query terms, 256-1024) is small
-# relative to B * (gather-op slowdown ~40-100x), i.e. always for real
-# query batches.
+# Cost model per batch: nnz_padded * ceil(n_uniq/TU)*TU compare/select
+# lane-ops for A plus 2*B*U1*rows MXU flops — vs the gather path's
+# nnz_padded * B slow gathers. Wins whenever the batch's unique-term
+# count is small relative to B * (gather-op slowdown ~40-100x), i.e.
+# always for real query batches.
+#
+# The grid is (doc_tiles, uniq_tiles): for each doc tile the output
+# block stays resident in VMEM while uniq tiles accumulate into it, and
+# ``n_uniq`` arrives by scalar prefetch so tiles past the batch's live
+# unique terms are SKIPPED — work scales with the actual unique count,
+# not the padded capacity, and arbitrarily large u_cap costs nothing.
 
-_PL_TD = 512          # docs per grid tile
-_PL_MAX_U = 1024      # A fits VMEM: [U1, Td] f32 <= 2MB
+_PL_TD = 512          # docs per grid tile (256 for small blocks)
+_PL_MAX_B = 2048      # VMEM: qc [B, TU] + out [B, TD] stay ~8MB
 
 
-def _pallas_kernel(uniq_ref, qc_ref, term_ref, imp_ref, out_ref,
-                   *, width: int, td: int):
-    uniq_col = uniq_ref[:]                           # [U1, 1] i32
+def _pallas_kernel(nuniq_ref, uniq_ref, qc_ref, term_ref, imp_ref,
+                   out_ref, *, width: int, td: int, tu: int):
+    u = pl.program_id(1)
 
-    def body(w, a):                                  # a [U1, Td]
-        term_row = term_ref[w, :][None, :]           # [1, Td] i32
-        imp_row = imp_ref[w, :][None, :]             # [1, Td] f32
-        eq = uniq_col == term_row                    # [U1, Td]
-        return a + jnp.where(eq, imp_row, 0.0)
+    @pl.when(u == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
 
-    u1 = uniq_col.shape[0]
-    a = jax.lax.fori_loop(0, width, body,
-                          jnp.zeros((u1, td), jnp.float32))
-    # the contraction rides the MXU: [B, U1] @ [U1, Td]. HIGHEST keeps
-    # f32-equivalent accumulation (the default bf16 passes cost ~0.4%
-    # relative error — enough to flip top-k near-ties); the matmul is
-    # not the kernel's bottleneck, the A build is.
-    out_ref[:] = jnp.dot(qc_ref[:], a,
-                         preferred_element_type=jnp.float32,
-                         precision=jax.lax.Precision.HIGHEST)
+    # tiles wholly past the live unique terms contribute nothing (their
+    # qc columns are zero by construction) — skip them
+    @pl.when(u * tu < nuniq_ref[0])
+    def _tile():
+        uniq_col = uniq_ref[:]                       # [TU, 1] i32
+
+        def body(w, a):                              # a [TU, Td]
+            term_row = term_ref[w, :][None, :]       # [1, Td] i32
+            imp_row = imp_ref[w, :][None, :]         # [1, Td] f32
+            eq = uniq_col == term_row                # [TU, Td]
+            return a + jnp.where(eq, imp_row, 0.0)
+
+        a = jax.lax.fori_loop(0, width, body,
+                              jnp.zeros((tu, td), jnp.float32))
+        # the contraction rides the MXU: [B, TU] @ [TU, Td]. HIGHEST
+        # keeps f32-equivalent accumulation (the default bf16 passes
+        # cost ~0.4% relative error — enough to flip top-k near-ties);
+        # the matmul is not the kernel's bottleneck, the A build is.
+        out_ref[:] += jnp.dot(qc_ref[:], a,
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)
+
+
+def _pl_tiles(rows_cap: int, B: int, u_cap: int) -> tuple[int, int]:
+    """(doc tile, uniq tile) for a block/batch shape. Bigger tiles
+    amortize grid overhead; the uniq tile shrinks for very wide batches
+    so qc [B, TU] + out [B, TD] stay within VMEM."""
+    td = _PL_TD if rows_cap % _PL_TD == 0 else _PL_TD // 2
+    tu = 512 if (B <= 1024 and u_cap % 512 == 0) else 256
+    return td, min(tu, u_cap)
 
 
 def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
@@ -212,11 +238,15 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
     """Fused ELL-block scoring on TPU: ``[B, rows_cap]`` scores."""
     import functools
 
-    from jax.experimental import pallas as pl
-
     rows_cap, width = impact.shape
     B, _ = qc_ext.shape
     u_cap = uniq.shape[0]
+    td, tu = _pl_tiles(rows_cap, B, u_cap)
+    # the grid floor-divides: a non-multiple capacity would silently
+    # drop the trailing tile (callers route through _pallas_eligible,
+    # but direct callers must fail loudly, not score wrong)
+    assert rows_cap % td == 0 and u_cap % tu == 0, \
+        (rows_cap, td, u_cap, tu)
     # pad entries of uniq must never match a real term id
     uniq_col = jnp.where(jnp.arange(u_cap) < n_uniq, uniq,
                          jnp.int32(-1))[:, None]     # [U1, 1]
@@ -224,27 +254,40 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
     imp_t = impact.T                                 # [W, rows] width-major
     term_t = term.T
 
-    kernel = functools.partial(_pallas_kernel, width=width, td=_PL_TD)
+    kernel = functools.partial(_pallas_kernel, width=width, td=td, tu=tu)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        # u is the INNER axis: the output block for a doc tile stays in
+        # VMEM while uniq tiles accumulate into it ("arbitrary" marks
+        # the accumulation-carried axis)
+        grid=(rows_cap // td, u_cap // tu),
+        in_specs=[
+            pl.BlockSpec((tu, 1), lambda d, u, n: (u, 0)),    # uniq ids
+            pl.BlockSpec((B, tu), lambda d, u, n: (0, u)),    # query w
+            pl.BlockSpec((width, td), lambda d, u, n: (0, d)),  # terms
+            pl.BlockSpec((width, td), lambda d, u, n: (0, d)),  # impacts
+        ],
+        out_specs=pl.BlockSpec((B, td), lambda d, u, n: (0, d)),
+    )
     return pl.pallas_call(
         kernel,
-        grid=(rows_cap // _PL_TD,),
-        in_specs=[
-            pl.BlockSpec((u_cap, 1), lambda i: (0, 0)),     # uniq ids
-            pl.BlockSpec((B, u_cap), lambda i: (0, 0)),     # query weights
-            pl.BlockSpec((width, _PL_TD), lambda i: (0, i)),  # terms
-            pl.BlockSpec((width, _PL_TD), lambda i: (0, i)),  # impacts
-        ],
-        out_specs=pl.BlockSpec((B, _PL_TD), lambda i: (0, i)),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, rows_cap), jnp.float32),
-        interpret=jax.default_backend() == "cpu",
-    )(uniq_col, qc, term_t, imp_t)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        # non-TPU backends (CPU tests, hypothetically GPU) run the
+        # reference interpreter instead of lowering a Mosaic program
+        interpret=jax.default_backend() != "tpu",
+    )(jnp.asarray(n_uniq, jnp.int32).reshape(1),
+      uniq_col, qc, term_t, imp_t)
 
 
 def _pallas_eligible(rows_cap: int, B: int, u_cap: int) -> bool:
     """Big blocks only — small blocks stay on the XLA path where they
-    are cheap; huge query batches (u_cap beyond VMEM) fall back too."""
-    return (rows_cap % _PL_TD == 0 and u_cap <= _PL_MAX_U
-            and B <= _PL_MAX_U)
+    are cheap. u_cap is unbounded (uniq tiles past ``n_uniq`` are
+    skipped, so capacity padding is free); B is VMEM-bounded."""
+    return (rows_cap % (_PL_TD // 2) == 0 and rows_cap >= _PL_TD // 2
+            and B <= _PL_MAX_B and u_cap % 256 == 0)
 
 
 def _pick_chunk(rows_cap: int, width: int, B: int, doc_chunk: int) -> int:

@@ -53,16 +53,24 @@ def merge_topk(vals: jax.Array,   # f32 [..., n_parts, B, k]
     return top_vals, top_ids
 
 
+def pack_topk(vals: jax.Array, ids: jax.Array) -> jax.Array:
+    """Pack values + (bitcast) i32 ids into ONE f32 ``[..., 2k]`` array —
+    the single-transfer wire layout :func:`unpack_topk` inverts. Shared
+    by every producer so the format lives in exactly one place."""
+    return jnp.concatenate(
+        [vals, jax.lax.bitcast_convert_type(ids.astype(jnp.int32),
+                                            jnp.float32)], axis=-1)
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def packed_topk(scores: jax.Array, num_docs: jax.Array,
                 *, k: int) -> jax.Array:
-    """Top-k with values and (bitcast) indices packed into ONE f32 array
+    """Top-k with values and indices packed into ONE f32 array
     ``[B, 2k]`` — a single device-to-host transfer fetches both. Matters
     when the host↔device link has high per-transfer latency (remote-TPU
     tunnels); unpack with :func:`unpack_topk`."""
     vals, idx = exact_topk(scores, num_docs, k=k)
-    return jnp.concatenate(
-        [vals, jax.lax.bitcast_convert_type(idx, jnp.float32)], axis=-1)
+    return pack_topk(vals, idx)
 
 
 def unpack_topk(packed) -> tuple:

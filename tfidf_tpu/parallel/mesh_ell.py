@@ -45,7 +45,7 @@ from tfidf_tpu.ops.ell import (_pallas_eligible, _score_block,
 from tfidf_tpu.ops.scoring import (QueryBatch, _compile_queries,
                                    bm25_weights, score_coo_compiled,
                                    tfidf_weights)
-from tfidf_tpu.ops.topk import exact_topk, merge_topk
+from tfidf_tpu.ops.topk import exact_topk, merge_topk, pack_topk
 
 # fixed width buckets so every shard shares one block structure
 ELL_WIDTHS = (256, 128, 64, 32, 16, 8)
@@ -264,7 +264,8 @@ def make_mesh_ell_search(mesh: Mesh,
                          model: str = "bm25",
                          k1: float = 1.2,
                          b: float = 0.75,
-                         use_pallas: bool = True):
+                         use_pallas: bool = True,
+                         packed: bool = False):
     """Distributed search over ELL base + COO delta.
 
     Returned callable:
@@ -275,6 +276,11 @@ def make_mesh_ell_search(mesh: Mesh,
     local < doc_cap_ell is an ELL row and local >= doc_cap_ell is a
     delta slot. Global stats arrive precomputed (the engine refreshes
     them at commit), so the step needs no df psum.
+
+    ``packed=True`` returns ONE f32 ``[B, 2k]`` array (ids bitcast) so
+    the caller fetches values and ids in a single device->host transfer
+    — on high-latency links (remote-TPU tunnels) the second fetch costs
+    a full RTT, which at k=10 dwarfs the payload.
     """
 
     def step(df_g, n_docs, avgdl, base_live, block_live,
@@ -363,7 +369,7 @@ def make_mesh_ell_search(mesh: Mesh,
         sharded = jax.shard_map(
             step, mesh=mesh, in_specs=in_specs(nb),
             out_specs=(P(), P()), check_vma=False)
-        return sharded(
+        vals, gids = sharded(
             df_g, n_docs, avgdl, base.live, base.block_live,
             base.res_tf, base.res_term, base.res_doc, base.res_dl,
             delta.tf, delta.term, delta.doc, delta.doc_len,
@@ -371,6 +377,9 @@ def make_mesh_ell_search(mesh: Mesh,
             jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
             jnp.asarray(q.slots), jnp.asarray(q.weights),
             *base.impact, *base.term)
+        if packed:
+            return pack_topk(vals, gids)
+        return vals, gids
 
     return search
 

@@ -331,10 +331,15 @@ class MeshIndex:
         return arrays
 
 
-class MeshSearcher:
+from tfidf_tpu.engine.searcher import QueryVectorizerMixin
+
+
+class MeshSearcher(QueryVectorizerMixin):
     """Query execution against MeshSnapshots — the distributed forward
     pass. Mirrors :class:`~tfidf_tpu.engine.searcher.Searcher`'s interface
-    so Engine/cluster code is layout-agnostic."""
+    so Engine/cluster code is layout-agnostic. Subclasses (the ELL mesh
+    layout) override only :meth:`_topk_chunk` / :meth:`_search_unbounded`
+    — the chunking and hit-assembly loop lives in one place."""
 
     def __init__(self, index: MeshIndex, analyzer, vocab,
                  model: ScoringModel,
@@ -369,7 +374,8 @@ class MeshSearcher:
             fn = make_sharded_search(
                 self.index.mesh, k=k,
                 model=self.model.score_kwargs()["model"],
-                global_idf=self.global_idf, **self._model_kwargs())
+                global_idf=self.global_idf, packed=True,
+                **self._model_kwargs())
             self._search_fns[k] = fn
         return fn
 
@@ -383,39 +389,58 @@ class MeshSearcher:
 
     def search(self, queries: list[str], k: int | None = None,
                *, unbounded: bool = False):
-        from tfidf_tpu.engine.searcher import SearchHit, vectorize_queries
-
         snap = self.index.snapshot
         if snap is None or snap.total_live == 0:
             return [[] for _ in queries]
+        if unbounded:
+            return self._search_unbounded(snap, queries, k)
         k = self.top_k if k is None else k
         out = []
         cap = self._batch_cap(len(queries))
         for lo in range(0, len(queries), cap):
             chunk = queries[lo:lo + cap]
-            bcap = self._batch_cap(len(chunk))
-            qb, _widest = vectorize_queries(
-                chunk, self.analyzer, self.vocab, self.model,
-                batch_cap=bcap, max_terms=self.max_query_terms)
-            if unbounded:
-                vals, gids, kk = self._rank_all(snap, qb)
-            else:
-                kk = min(k, snap.arrays.doc_cap)
-                vals_d, gids_d = self._get_search_fn(kk)(snap.arrays, qb)
-                vals, gids = np.asarray(vals_d), np.asarray(gids_d)
-            for i in range(len(chunk)):
-                hits = []
-                for v, g in zip(vals[i, :kk], gids[i, :kk]):
-                    if not (np.isfinite(v) and v > 0.0):
-                        continue
-                    name = snap.name_of(int(g))
-                    if name is not None:
-                        hits.append(SearchHit(name, float(v)))
-                if self.result_order == "name":
-                    hits.sort(key=lambda h: h.name)
-                out.append(hits)
+            qb, _widest = self._vectorize(chunk,
+                                          self._batch_cap(len(chunk)))
+            vals, gids, kk = self._topk_chunk(snap, qb, k)
+            out.extend(self._assemble_hits(snap, chunk, vals, gids, kk))
         global_metrics.inc("queries_served", len(queries))
         return out
+
+    def _topk_chunk(self, snap, qb, k: int):
+        """Layout hook: exact top-k for one vectorized chunk."""
+        from tfidf_tpu.ops.topk import unpack_topk
+        kk = min(k, snap.arrays.doc_cap)
+        vals, gids = unpack_topk(self._get_search_fn(kk)(snap.arrays, qb))
+        return vals, gids, kk
+
+    def _search_unbounded(self, snap, queries, k):
+        """Layout hook: the reference's unbounded (parity) results."""
+        out = []
+        cap = self._batch_cap(len(queries))
+        for lo in range(0, len(queries), cap):
+            chunk = queries[lo:lo + cap]
+            qb, _widest = self._vectorize(chunk,
+                                          self._batch_cap(len(chunk)))
+            vals, gids, kk = self._rank_all(snap, qb)
+            out.extend(self._assemble_hits(snap, chunk, vals, gids, kk))
+        global_metrics.inc("queries_served", len(queries))
+        return out
+
+    def _assemble_hits(self, snap, chunk, vals, gids, kk):
+        from tfidf_tpu.engine.searcher import SearchHit
+        results = []
+        for i in range(len(chunk)):
+            hits = []
+            for v, g in zip(vals[i, :kk], gids[i, :kk]):
+                if not (np.isfinite(v) and v > 0.0):
+                    continue
+                name = snap.name_of(int(g))
+                if name is not None:
+                    hits.append(SearchHit(name, float(v)))
+            if self.result_order == "name":
+                hits.sort(key=lambda h: h.name)
+            results.append(hits)
+        return results
 
     def _rank_all(self, snap: MeshSnapshot, qb):
         """Parity mode: full per-shard score matrices ranked on the host

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tfidf_tpu.ops.csr import CooShard, next_capacity
 from tfidf_tpu.ops.scoring import (QueryBatch, cosine_norms,
                                    score_coo_impl)
-from tfidf_tpu.ops.topk import exact_topk, merge_topk
+from tfidf_tpu.ops.topk import exact_topk, merge_topk, pack_topk
 
 
 @dataclass
@@ -210,7 +210,8 @@ def make_sharded_search(mesh: Mesh,
                         k1: float = 1.2,
                         b: float = 0.75,
                         global_idf: bool = True,
-                        chunk: int = 1 << 17):
+                        chunk: int = 1 << 17,
+                        packed: bool = False):
     """Build the jitted distributed search step for a fixed mesh/model.
 
     Returned callable:
@@ -290,11 +291,18 @@ def make_sharded_search(mesh: Mesh,
 
     @jax.jit
     def search(arrays: ShardedArrays, q: QueryBatch):
-        return sharded(arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
-                       arrays.df, arrays.n_live, arrays.live,
-                       arrays.len_sum,
-                       jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
-                       jnp.asarray(q.slots), jnp.asarray(q.weights))
+        vals, gids = sharded(
+            arrays.tf, arrays.term, arrays.doc, arrays.doc_len,
+            arrays.df, arrays.n_live, arrays.live,
+            arrays.len_sum,
+            jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
+            jnp.asarray(q.slots), jnp.asarray(q.weights))
+        if packed:
+            # one [B, 2k] f32 buffer: values + bitcast ids fetched in a
+            # single device->host transfer (the second fetch costs a full
+            # RTT on tunneled links)
+            return pack_topk(vals, gids)
+        return vals, gids
 
     return search
 

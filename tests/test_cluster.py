@@ -255,6 +255,31 @@ class TestClusterEndToEnd:
                  for w in json.loads(http_get(leader.url + "/api/services"))]
         assert all(s > 0 for s in sizes)
 
+    def test_bulk_upload_batch_and_nrt_visibility(self, cluster):
+        """Framework addition: /leader/upload-batch places a whole batch
+        with one request per worker; deferred (NRT) commits are flushed
+        by the next search, so read-your-writes holds end to end."""
+        leader = cluster[0]
+        docs = [{"name": f"bulk{i}.txt",
+                 "text": f"zebra stripe number {i} " + ("grass " * (i % 3))}
+                for i in range(20)]
+        resp = json.loads(http_post(leader.url + "/leader/upload-batch",
+                                    json.dumps(docs).encode()))
+        assert sum(resp["placed"].values()) == 20
+        assert len(resp["placed"]) == 2          # spread over both workers
+        result = json.loads(http_post(leader.url + "/leader/start",
+                                      b"zebra"))
+        assert len(result) > 0                   # visible without explicit
+        names = set(result)                      # commit (NRT flush)
+        assert names <= {d["name"] for d in docs}
+        # re-upload an existing name: routes to the SAME worker (upsert,
+        # not duplicate) — placement map, ADVICE r2
+        orig = leader._placement["bulk0.txt"]
+        one = [{"name": "bulk0.txt", "text": "entirely new content"}]
+        resp2 = json.loads(http_post(leader.url + "/leader/upload-batch",
+                                     json.dumps(one).encode()))
+        assert list(resp2["placed"]) == [orig]
+
     def test_multipart_upload(self, cluster):
         leader = cluster[0]
         boundary = "XbOuNdArYX"
@@ -440,6 +465,9 @@ class TestMeshCluster:
             for name, data in docs.items():
                 http_post(leader.url + f"/leader/upload?name={name}", data,
                           content_type="application/octet-stream")
+            # NRT commit policy: uploads defer the commit; the next
+            # search flushes it (read-your-writes via commit_if_dirty)
+            worker.commit_if_dirty()
             # committed into sharded device arrays, spread over the mesh
             snap = worker.engine.index.snapshot
             assert snap is not None and snap.total_live == 4

@@ -118,6 +118,15 @@ def test_empty_index_search(tmp_path):
     assert e.search("anything") == []
 
 
+def test_empty_query_list_on_nonempty_index(tmp_path):
+    """Regression: the pipelined chunk loop must not dereference a
+    never-filled pending slot when zero chunks are dispatched."""
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    assert e.search_batch([]) == []
+    assert e.search_batch([], unbounded=True) == []
+
+
 def test_build_from_directory_and_download(tmp_path):
     docs_dir = tmp_path / "docs" / "sub"
     docs_dir.mkdir(parents=True)

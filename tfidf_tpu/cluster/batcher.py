@@ -63,10 +63,13 @@ class QueryBatcher:
     def search(self, query: str, k: int | None = None,
                unbounded: bool = False):
         """Submit one query; returns its hit list (blocking)."""
-        if self._stopping:
-            raise RuntimeError("batcher stopped")
         w = _Waiter(query, k, unbounded)
+        # check-and-enqueue under the lock: a check outside it could pass
+        # just before stop() drains the queue, leaving this waiter parked
+        # forever (ADVICE r2)
         with self._lock:
+            if self._stopping:
+                raise RuntimeError("batcher stopped")
             self._items.append(w)
         self._wake.set()
         w.event.wait()
@@ -75,7 +78,8 @@ class QueryBatcher:
         return w.result
 
     def stop(self) -> None:
-        self._stopping = True
+        with self._lock:
+            self._stopping = True
         self._wake.set()
         self._thread.join(timeout=2.0)
         # fail any stragglers rather than hanging their handler threads

@@ -8,12 +8,14 @@ unmodified:
 Worker data plane (``worker/Worker.java``):
     POST /worker/process      — score a query against the local shard (:175)
     POST /worker/upload       — save + index one document (:125)
+    POST /worker/upload-batch — framework addition: bulk text ingest
     GET  /worker/download     — stream a document, traversal-safe (:97)
     GET  /worker/index-size   — load metric in bytes (:147)
 
 Leader control plane (``leader/Leader.java``):
     POST /leader/start        — scatter-gather search, sum-merge (:39-92)
     POST /leader/upload       — least-loaded placement (:153-207)
+    POST /leader/upload-batch — framework addition: bulk placement
     GET  /leader/download     — local disk, else probe workers (:95-151)
 
 Ops (``controller/Controllers.java``):
@@ -113,6 +115,18 @@ class SearchNode:
             self.engine, max_batch=self.config.query_batch,
             linger_s=self.config.batch_linger_ms / 1e3)
             if self.config.micro_batch else None)
+        # near-real-time commit policy (Lucene NRT readers): uploads
+        # defer the commit; the next search commits pending writes first,
+        # so read-your-writes visibility matches the reference's
+        # commit-per-upload (Worker.java:138) without its O(corpus)
+        # per-document cost on bulk ingest
+        self._dirty = False
+        self._commit_lock = threading.Lock()
+        # leader-side upload placement: TTL cache over worker index
+        # sizes + in-tenure name->worker map (re-uploads route to the
+        # holder, keeping one copy per name; see leader_upload)
+        self._size_cache: tuple[float, dict[str, int]] = (0.0, {})
+        self._placement: dict[str, str] = {}
 
         handler = type("Handler", (_NodeHandler,), {"node": self})
         self.httpd = ThreadingHTTPServer(
@@ -156,10 +170,25 @@ class SearchNode:
         concurrent requests. ``unbounded_results=True`` restores the
         reference's full-ranking behavior (``Worker.java:230``) for
         parity."""
+        self.commit_if_dirty()
         unbounded = self.config.unbounded_results
         if self.batcher is not None:
             return self.batcher.search(query, unbounded=unbounded)
         return self.engine.search(query, unbounded=unbounded)
+
+    def notify_write(self) -> None:
+        """Mark uncommitted writes (called by the upload handler)."""
+        self._dirty = True
+
+    def commit_if_dirty(self) -> None:
+        """NRT visibility point: flush deferred upload commits before
+        serving a search. Clearing the flag before committing means a
+        write landing mid-commit re-dirties and is flushed next time."""
+        if self._dirty:
+            with self._commit_lock:
+                if self._dirty:
+                    self._dirty = False
+                    self.engine.commit()
 
     # ---- session-expiry recovery ----
 
@@ -252,29 +281,108 @@ class SearchNode:
             return dict(sorted(merged.items()))
         return dict(sorted(merged.items(), key=lambda kv: (-kv[1], kv[0])))
 
+    # size polls are cached this long; between polls the leader grows
+    # its local estimates by the bytes it placed, so bursts still spread
+    _SIZE_POLL_TTL_S = 1.0
+
+    def _polled_sizes(self, workers: list[str]) -> dict[str, int]:
+        """Worker index sizes with a TTL cache over the per-upload
+        polling loop of ``Leader.java:170-179``. Raises when no worker
+        answers. The returned dict is the live cache: callers bump the
+        chosen worker's estimate after a successful placement."""
+        now = time.monotonic()
+        ts, sizes = self._size_cache
+        if now - ts > self._SIZE_POLL_TTL_S or set(sizes) != set(workers):
+            sizes = {}
+            for w in workers:   # serial polling, like Leader.java:170-179
+                try:
+                    global_injector.check("leader.size_poll")
+                    sizes[w] = int(http_get(w + "/worker/index-size"))
+                except Exception as e:
+                    log.warning("index-size poll failed", worker=w,
+                                err=repr(e))
+            if not sizes:
+                raise RuntimeError("no reachable workers")
+            self._size_cache = (now, sizes)
+        return sizes
+
     def leader_upload(self, filename: str, data: bytes) -> dict:
-        """Least-loaded placement (``Leader.java:153-207``): poll every
-        worker's index size, forward the file to the smallest."""
+        """Least-loaded placement (``Leader.java:153-207``) with two
+        framework improvements over the reference's per-upload loop:
+
+        * worker index sizes are polled at most once per TTL (the
+          reference polls every worker for every file,
+          ``Leader.java:170-179`` — O(workers) HTTP round trips per
+          document kills bulk ingest);
+        * a name seen before routes to the worker already holding it,
+          so a re-upload UPSERTS the one existing copy instead of
+          placing a duplicate on the currently-smallest worker (which
+          would double-count the name in the scatter-gather sum-merge).
+          The map is per-leader-tenure; a name placed under a previous
+          leader may still duplicate — the reference has no dedup at
+          all.
+        """
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
-        sizes: dict[str, int] = {}
-        for w in workers:   # serial polling, like Leader.java:170-179
-            try:
-                global_injector.check("leader.size_poll")
-                sizes[w] = int(http_get(w + "/worker/index-size"))
-            except Exception as e:
-                log.warning("index-size poll failed", worker=w, err=repr(e))
-        if not sizes:
-            raise RuntimeError("no reachable workers")
-        chosen = min(sizes, key=lambda w: (sizes[w], w))
+        held = self._placement.get(filename)
+        if held in workers:
+            chosen = held
+            sizes = self._size_cache[1]
+        else:
+            sizes = self._polled_sizes(workers)
+            chosen = min(sizes, key=lambda w: (sizes[w], w))
         q = urllib.parse.quote(filename)
         http_post(chosen + f"/worker/upload?name={q}", data,
                   content_type="application/octet-stream")
+        # placement/size state is updated only AFTER the worker accepted
+        sizes[chosen] = sizes.get(chosen, 0) + len(data)
+        self._placement[filename] = chosen
         global_metrics.inc("uploads_placed")
         log.info("upload placed", file=filename, worker=chosen,
                  size=sizes[chosen])
-        return {"worker": chosen, "sizes": sizes}
+        return {"worker": chosen, "sizes": dict(sizes)}
+
+    def leader_upload_batch(self, docs: list[dict]) -> dict:
+        """Bulk ingest (framework addition — the reference only places
+        one file per request): place each named document with the same
+        cached least-loaded policy, then forward ONE ``upload-batch``
+        request per worker. Payloads are JSON ``{"name", "text"}``
+        (text documents; binary uploads use the per-file endpoint)."""
+        workers = self.registry.get_all_service_addresses()
+        if not workers:
+            raise RuntimeError("no workers registered")
+        sizes = self._polled_sizes(workers)
+        # plan the split with a local estimate; the shared cache and the
+        # placement map are updated only for groups a worker ACCEPTED —
+        # a failed forward must not leave the leader believing the
+        # unreachable worker holds documents it never received
+        est = dict(sizes)
+        per_worker: dict[str, list[dict]] = {}
+        for d in docs:
+            name = d["name"]
+            held = self._placement.get(name)
+            w = held if held in est else min(
+                est, key=lambda x: (est[x], x))
+            per_worker.setdefault(w, []).append(d)
+            est[w] = est.get(w, 0) + len(d.get("text", ""))
+        placed = {}
+        errors = {}
+        for w, group in per_worker.items():
+            try:
+                http_post(w + "/worker/upload-batch",
+                          json.dumps(group).encode(), timeout=300.0)
+            except Exception as e:
+                errors[w] = repr(e)
+                continue
+            placed[w] = len(group)
+            for d in group:
+                self._placement[d["name"]] = w
+                sizes[w] = sizes.get(w, 0) + len(d.get("text", ""))
+            global_metrics.inc("uploads_placed", len(group))
+        if errors and not placed:
+            raise RuntimeError(f"all workers failed: {errors}")
+        return {"placed": placed, **({"errors": errors} if errors else {})}
 
     def leader_download(self, rel: str) -> bytes | None:
         """Serve from local disk, else probe every worker and proxy the
@@ -400,10 +508,31 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._text("missing file name", 400)
                     return
                 global_injector.check("worker.upload")
-                # docs_indexed is counted once, by the index add path
+                # docs_indexed is counted once, by the index add path;
+                # the commit is deferred to the next search (NRT policy,
+                # see SearchNode.commit_if_dirty) — the raw file is
+                # already durable on disk at this point
                 node.engine.ingest_bytes(name, data, save_to_disk=True)
-                node.engine.commit()
+                node.notify_write()
                 self._text(f"File {name} uploaded and indexed")
+            elif u.path == "/worker/upload-batch":
+                docs = json.loads(self._body().decode("utf-8"))
+                global_injector.check("worker.upload")
+                try:
+                    for d in docs:
+                        node.engine.ingest_bytes(
+                            d["name"], d["text"].encode("utf-8"),
+                            save_to_disk=True)
+                finally:
+                    # mark dirty even on a mid-batch failure: the docs
+                    # already ingested must become searchable at the
+                    # next NRT flush, not be stranded uncommitted
+                    if docs:
+                        node.notify_write()
+                self._text(f"{len(docs)} files uploaded and indexed")
+            elif u.path == "/leader/upload-batch":
+                docs = json.loads(self._body().decode("utf-8"))
+                self._json(node.leader_upload_batch(docs))
             elif u.path == "/leader/start":
                 query = self._read_query()
                 self._json(node.leader_search(query))

@@ -27,7 +27,8 @@ from tfidf_tpu.ops.csr import next_capacity
 from tfidf_tpu.ops.ell import score_ell_batch, score_segments_batch
 from tfidf_tpu.ops.scoring import (QueryBatch, make_query_batch,
                                    score_coo_batch)
-from tfidf_tpu.ops.topk import full_ranking, packed_topk, unpack_topk
+from tfidf_tpu.ops.topk import (full_ranking, packed_topk,
+                                packed_topk_chunked, unpack_topk)
 from tfidf_tpu.utils.metrics import global_metrics
 from tfidf_tpu.utils.tracing import trace_phase
 
@@ -117,24 +118,41 @@ class Searcher(QueryVectorizerMixin):
         ``unbounded=True`` returns every matching document (the reference's
         ``Integer.MAX_VALUE`` behavior, ``Worker.java:230``) via a host-side
         full ranking — parity mode only; exact top-k is the fast path.
+
+        Chunks are PIPELINED one deep: chunk i+1's device program is
+        dispatched before chunk i's packed top-k is fetched, so the
+        device->host round trip and host-side hit assembly hide under the
+        next chunk's device time. On high-latency links (remote-TPU
+        tunnels, ~100ms RTT) this is the difference between
+        latency-bound and compute-bound throughput.
         """
         snap = self.index.snapshot
-        if snap is None or not snap.doc_names:
+        if snap is None or not snap.doc_names or not queries:
             return [[] for _ in queries]
         k = self.top_k if k is None else k
         out: list[list[SearchHit]] = []
         cap = self._batch_cap(len(queries))
+        if unbounded:
+            for lo in range(0, len(queries), cap):
+                chunk = queries[lo:lo + cap]
+                out.extend(self._search_unbounded(snap, chunk))
+            global_metrics.inc("queries_served", len(queries))
+            return out
+        pending = None                 # (chunk, packed device array, kk)
         for lo in range(0, len(queries), cap):
             chunk = queries[lo:lo + cap]
-            out.extend(self._search_batch(snap, chunk, k, unbounded))
+            dispatched = self._dispatch_chunk(snap, chunk, k)
+            if pending is not None:
+                out.extend(self._finish_chunk(snap, *pending))
+            pending = (chunk,) + dispatched
+        out.extend(self._finish_chunk(snap, *pending))
         global_metrics.inc("queries_served", len(queries))
         return out
 
-    def _search_batch(self, snap: Snapshot, queries: list[str], k: int,
-                      unbounded: bool) -> list[list[SearchHit]]:
+    def _score_chunk(self, snap: Snapshot, queries: list[str]):
         cap = self._batch_cap(len(queries))
         with trace_phase("vectorize"):
-            qb, widest = self._vectorize(queries, cap)
+            qb, _widest = self._vectorize(queries, cap)
         with trace_phase("score"):
             if isinstance(snap, SegmentedSnapshot):
                 scores = score_segments_batch(
@@ -155,26 +173,43 @@ class Searcher(QueryVectorizerMixin):
                     snap.tf, snap.term, snap.doc, snap.doc_len, snap.df,
                     qb, snap.n_docs, snap.avgdl, snap.doc_norms,
                     **self.model.score_kwargs())
+        return scores
+
+    def _dispatch_chunk(self, snap: Snapshot, queries: list[str],
+                        k: int):
+        """Launch one chunk's device work; returns (packed, kk) with the
+        packed top-k still ON DEVICE (not fetched)."""
+        scores = self._score_chunk(snap, queries)
+        with trace_phase("topk"):
+            kk = min(k, len(snap.doc_names))
+            return packed_topk_chunked(scores, snap.num_docs, k=kk), kk
+
+    def _finish_chunk(self, snap: Snapshot, queries: list[str],
+                      packed, kk: int) -> list[list[SearchHit]]:
+        # ONE d2h transfer for values+ids (high-latency host<->device
+        # links make per-fetch cost dominate)
+        vals, ids = unpack_topk(packed)
+        return self._assemble(snap, queries, vals, ids, kk)
+
+    def _search_unbounded(self, snap: Snapshot,
+                          queries: list[str]) -> list[list[SearchHit]]:
+        scores = self._score_chunk(snap, queries)
         segmented = isinstance(snap, SegmentedSnapshot)
-        n_live = len(snap.doc_names)
-        if unbounded:
-            with trace_phase("rank_all"):
-                # segmented doc ids interleave padding, so rank the whole
-                # padded space (pads score 0 and are filtered below)
-                rank_n = scores.shape[-1] if segmented else n_live
-                vals, ids = full_ranking(scores, rank_n)
-                vals = np.asarray(vals)
-                ids = np.asarray(ids)
-                kk = rank_n
-        else:
-            with trace_phase("topk"):
-                kk = min(k, n_live)
-                # packed: ONE d2h transfer for values+ids (high-latency
-                # host<->device links make per-fetch cost dominate)
-                vals, ids = unpack_topk(
-                    packed_topk(scores, snap.num_docs, k=kk))
-        results: list[list[SearchHit]] = []
+        with trace_phase("rank_all"):
+            # segmented doc ids interleave padding, so rank the whole
+            # padded space (pads score 0 and are filtered below)
+            rank_n = (scores.shape[-1] if segmented
+                      else len(snap.doc_names))
+            vals, ids = full_ranking(scores, rank_n)
+            vals = np.asarray(vals)
+            ids = np.asarray(ids)
+        return self._assemble(snap, queries, vals, ids, rank_n)
+
+    def _assemble(self, snap: Snapshot, queries: list[str], vals, ids,
+                  kk: int) -> list[list[SearchHit]]:
+        segmented = isinstance(snap, SegmentedSnapshot)
         names = snap.padded_names if segmented else snap.doc_names
+        results: list[list[SearchHit]] = []
         for i in range(len(queries)):
             hits = [SearchHit(names[int(d)], float(v))
                     for v, d in zip(vals[i, :kk], ids[i, :kk])

@@ -189,17 +189,21 @@ _PL_TD = 512          # docs per grid tile (256 for small blocks)
 _PL_MAX_B = 2048      # VMEM: qc [B, TU] + out [B, TD] stay ~8MB
 
 
-def _pallas_kernel(nuniq_ref, uniq_ref, qc_ref, term_ref, imp_ref,
+def _pallas_kernel(lims_ref, uniq_ref, qc_ref, term_ref, imp_ref,
                    out_ref, *, width: int, td: int, tu: int):
+    d = pl.program_id(0)
     u = pl.program_id(1)
 
     @pl.when(u == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # tiles wholly past the live unique terms contribute nothing (their
-    # qc columns are zero by construction) — skip them
-    @pl.when(u * tu < nuniq_ref[0])
+    # tiles wholly past the live unique terms (zero qc columns) or past
+    # the block's live rows (all-pad postings; power-of-two row caps
+    # leave up to 2x dead rows, and their scores are never gathered by
+    # _rearrange_to_real) contribute nothing — skip them
+    @pl.when(jnp.logical_and(u * tu < lims_ref[0],
+                             d * td < lims_ref[1]))
     def _tile():
         uniq_col = uniq_ref[:]                       # [TU, 1] i32
 
@@ -222,11 +226,15 @@ def _pallas_kernel(nuniq_ref, uniq_ref, qc_ref, term_ref, imp_ref,
 
 def _pl_tiles(rows_cap: int, B: int, u_cap: int) -> tuple[int, int]:
     """(doc tile, uniq tile) for a block/batch shape. Bigger tiles
-    amortize grid overhead; the uniq tile shrinks for very wide batches
-    so qc [B, TU] + out [B, TD] stay within VMEM."""
-    td = _PL_TD if rows_cap % _PL_TD == 0 else _PL_TD // 2
-    tu = 512 if (B <= 1024 and u_cap % 512 == 0) else 256
-    return td, min(tu, u_cap)
+    amortize grid overhead; both tiles shrink as B grows so the
+    multi-buffered qc [B, TU] / out [B, TD] blocks plus the A
+    accumulator and MXU temporaries stay inside the 16MB scoped-VMEM
+    budget (measured: Mosaic's buffering costs ~2x the naive block
+    arithmetic, so the schedule is deliberately conservative)."""
+    cap = 512 if B <= 512 else (256 if B <= 1024 else 128)
+    td = min(cap, _PL_TD if rows_cap % _PL_TD == 0 else _PL_TD // 2)
+    tu = min(cap, 512 if u_cap % 512 == 0 else 256, u_cap)
+    return td, tu
 
 
 def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
@@ -234,8 +242,14 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
                        uniq: jax.Array,      # i32 [U_cap] batch term ids
                        n_uniq: jax.Array,    # i32 scalar (traced)
                        qc_ext: jax.Array,    # f32 [B, U_cap+1]
+                       n_rows: jax.Array | None = None,  # i32 scalar
                        ) -> jax.Array:
-    """Fused ELL-block scoring on TPU: ``[B, rows_cap]`` scores."""
+    """Fused ELL-block scoring on TPU: ``[B, rows_cap]`` scores.
+
+    ``n_rows`` (traced) is the block's live row count: doc tiles wholly
+    past it skip the A-build and contraction (their scores are zeroed by
+    the unconditional init, exactly what all-pad rows would score).
+    """
     import functools
 
     rows_cap, width = impact.shape
@@ -253,6 +267,10 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
     qc = qc_ext[:, :u_cap]                           # drop the zero column
     imp_t = impact.T                                 # [W, rows] width-major
     term_t = term.T
+    if n_rows is None:
+        n_rows = jnp.int32(rows_cap)
+    lims = jnp.stack([jnp.asarray(n_uniq, jnp.int32),
+                      jnp.asarray(n_rows, jnp.int32)])
 
     kernel = functools.partial(_pallas_kernel, width=width, td=td, tu=tu)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -278,8 +296,7 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
         # non-TPU backends (CPU tests, hypothetically GPU) run the
         # reference interpreter instead of lowering a Mosaic program
         interpret=jax.default_backend() != "tpu",
-    )(jnp.asarray(n_uniq, jnp.int32).reshape(1),
-      uniq_col, qc, term_t, imp_t)
+    )(lims, uniq_col, qc, term_t, imp_t)
 
 
 def _pallas_eligible(rows_cap: int, B: int, u_cap: int) -> bool:
@@ -379,10 +396,11 @@ def score_ell_impl(impacts,            # tuple of f32 [rows_cap_i, width_i]
     slot_of, qc_ext = _compile_queries(q, vocab_cap)
     qc_t = qc_ext.T                                   # [U_cap+1, B]
     u_cap = q.uniq.shape[0]
-    parts = [score_block_pallas(imp, term, q.uniq, q.n_uniq, qc_ext)
+    parts = [score_block_pallas(imp, term, q.uniq, q.n_uniq, qc_ext,
+                                block_live[i])
              if use_pallas and _pallas_eligible(imp.shape[0], B, u_cap)
              else _score_block(imp, term, slot_of, qc_t, doc_chunk)
-             for imp, term in zip(impacts, terms)]
+             for i, (imp, term) in enumerate(zip(impacts, terms))]
     return _rearrange_to_real(parts, [imp.shape[0] for imp in impacts],
                               block_live, doc_cap, B)
 

@@ -84,6 +84,42 @@ def unpack_topk(packed) -> tuple:
     return vals, ids
 
 
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def packed_topk_chunked(scores: jax.Array, num_docs: jax.Array,
+                        *, k: int, chunk: int = 1 << 17) -> jax.Array:
+    """:func:`packed_topk` with the doc axis scanned in chunks.
+
+    ``lax.top_k`` over a [B, doc_cap] matrix allocates value+index
+    temporaries proportional to the whole input — at 1M docs and B≥1024
+    that (with the scores themselves) exceeds HBM. Scanning doc chunks
+    bounds the temporaries at O(B * chunk) and merges per-chunk winners
+    (exact: the global top-k is contained in the union of chunk top-ks).
+    """
+    B, doc_cap = scores.shape
+    c = min(chunk, doc_cap)
+    while doc_cap % c:          # power-of-two caps make this a no-op
+        c -= 1
+    n = doc_cap // c
+    if n == 1:
+        return packed_topk(scores, num_docs, k=k)
+
+    def body(_, off):
+        # dynamic_slice, NOT a [B, n, c] reshape+transpose: that would
+        # materialize a second doc_cap-sized copy of the scores, which
+        # at 1M docs and wide batches is the difference between fitting
+        # HBM and not
+        x = jax.lax.dynamic_slice_in_dim(scores, off, c, axis=1)
+        idx = jnp.arange(c, dtype=jnp.int32)[None, :] + off
+        masked = jnp.where(idx < num_docs, x, -jnp.inf)
+        v, i = jax.lax.top_k(masked, k)
+        return None, (v, i.astype(jnp.int32) + off)
+
+    offs = jnp.arange(n, dtype=jnp.int32) * c
+    _, (vals, ids) = jax.lax.scan(body, None, offs)    # [n, B, k]
+    top_vals, top_ids = merge_topk(vals, ids)
+    return pack_topk(top_vals, top_ids)
+
+
 def full_ranking(scores: jax.Array, num_docs: int) -> tuple[jax.Array, jax.Array]:
     """All live documents sorted by descending score — the parity-mode analog
     of the reference's unbounded result set (host-side use only)."""

@@ -314,10 +314,10 @@ def make_mesh_ell_search(mesh: Mesh,
 
         # --- ELL base: same per-block scorers as single-device ---
         parts = []
-        for imp, term in zip(impacts, terms):
+        for i, (imp, term) in enumerate(zip(impacts, terms)):
             if use_pallas and _pallas_eligible(imp.shape[0], B, u_cap):
                 parts.append(score_block_pallas(
-                    imp, term, q.uniq, q.n_uniq, qc_ext))
+                    imp, term, q.uniq, q.n_uniq, qc_ext, block_live[i]))
             else:
                 parts.append(_score_block(imp, term, slot_of, qc_t, 2048))
         ell_scores = _rearrange_to_real(

@@ -413,13 +413,20 @@ class MeshEllSearcher(MeshSearcher):
             self._search_fns[k] = fn
         return fn
 
-    def _topk_chunk(self, snap, qb, k: int):
-        from tfidf_tpu.ops.topk import unpack_topk
+    def _on_snapshot(self, snap) -> None:
+        # the parity-fallback cache pins a full device-resident COO copy
+        # of the corpus; release it as soon as the snapshot advances
+        # instead of holding stale HBM until the next unbounded call
+        cached = getattr(self, "_unbounded_cache", None)
+        if cached is not None and (snap is None
+                                   or cached[0] != snap.version):
+            self._unbounded_cache = None
+
+    def _dispatch_chunk(self, snap, qb, k: int):
         kk = min(k, snap.stride)
-        vals, gids = unpack_topk(self._get_search_fn(kk)(
+        return self._get_search_fn(kk)(
             snap.base, snap.delta, snap.df_g, snap.n_docs,
-            snap.avgdl, qb))
-        return vals, gids, kk
+            snap.avgdl, qb), kk
 
     def _search_unbounded(self, snap, queries, k):
         # the ELL base cannot rank every matching document (its row

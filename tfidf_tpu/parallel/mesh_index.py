@@ -389,29 +389,45 @@ class MeshSearcher(QueryVectorizerMixin):
 
     def search(self, queries: list[str], k: int | None = None,
                *, unbounded: bool = False):
+        """Chunks are pipelined one deep, as in
+        :meth:`tfidf_tpu.engine.searcher.Searcher.search`: the next
+        chunk's shard_map program is dispatched before the previous
+        chunk's packed top-k is fetched, hiding the device->host RTT."""
         snap = self.index.snapshot
-        if snap is None or snap.total_live == 0:
+        self._on_snapshot(snap)
+        if snap is None or snap.total_live == 0 or not queries:
             return [[] for _ in queries]
         if unbounded:
             return self._search_unbounded(snap, queries, k)
         k = self.top_k if k is None else k
         out = []
         cap = self._batch_cap(len(queries))
+        pending = None              # (chunk, packed device array, kk)
         for lo in range(0, len(queries), cap):
             chunk = queries[lo:lo + cap]
             qb, _widest = self._vectorize(chunk,
                                           self._batch_cap(len(chunk)))
-            vals, gids, kk = self._topk_chunk(snap, qb, k)
-            out.extend(self._assemble_hits(snap, chunk, vals, gids, kk))
+            dispatched = self._dispatch_chunk(snap, qb, k)
+            if pending is not None:
+                out.extend(self._finish_chunk(snap, *pending))
+            pending = (chunk,) + dispatched
+        out.extend(self._finish_chunk(snap, *pending))
         global_metrics.inc("queries_served", len(queries))
         return out
 
-    def _topk_chunk(self, snap, qb, k: int):
-        """Layout hook: exact top-k for one vectorized chunk."""
-        from tfidf_tpu.ops.topk import unpack_topk
+    def _on_snapshot(self, snap) -> None:
+        """Layout hook: called with the snapshot each search (lets
+        subclasses drop per-snapshot caches when the version moves)."""
+
+    def _dispatch_chunk(self, snap, qb, k: int):
+        """Layout hook: launch one chunk's packed top-k (not fetched)."""
         kk = min(k, snap.arrays.doc_cap)
-        vals, gids = unpack_topk(self._get_search_fn(kk)(snap.arrays, qb))
-        return vals, gids, kk
+        return self._get_search_fn(kk)(snap.arrays, qb), kk
+
+    def _finish_chunk(self, snap, chunk, packed, kk: int):
+        from tfidf_tpu.ops.topk import unpack_topk
+        vals, gids = unpack_topk(packed)
+        return self._assemble_hits(snap, chunk, vals, gids, kk)
 
     def _search_unbounded(self, snap, queries, k):
         """Layout hook: the reference's unbounded (parity) results."""

@@ -279,6 +279,82 @@ class TestClusterEndToEnd:
         resp2 = json.loads(http_post(leader.url + "/leader/upload-batch",
                                      json.dumps(one).encode()))
         assert list(resp2["placed"]) == [orig]
+        # a doc the worker refuses (binary-looking text) is reported as
+        # skipped, excluded from placed counts and the placement map
+        bad = [{"name": "bad.pdf", "text": "%PDF-1.4 but no streams"},
+               {"name": "good.txt", "text": "perfectly fine words"}]
+        resp3 = json.loads(http_post(leader.url + "/leader/upload-batch",
+                                     json.dumps(bad).encode()))
+        assert sum(resp3["placed"].values()) == 1
+        assert [s["name"] for s in resp3["skipped"]] == ["bad.pdf"]
+        assert "bad.pdf" not in leader._placement
+        assert "good.txt" in leader._placement
+
+    def test_large_download_streams_with_bounded_reads(self, cluster):
+        """A big document flows worker -> leader -> client in bounded
+        chunks (Leader.java:95-151 FileSystemResource parity): no hop
+        buffers the whole file, and the bytes survive the two-hop
+        chunked proxy exactly."""
+        import hashlib
+        import os as _os
+
+        leader, worker = cluster[0], cluster[1]
+        # place a ~9MB file directly in a worker's documents dir (upload
+        # paths are text-oriented; download must serve any bytes)
+        blob = _os.urandom(1 << 20) * 9
+        path = worker.engine._safe_doc_path("big.bin")
+        _os.makedirs(_os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(blob)
+
+        reads = []
+        orig = worker.engine.open_document_stream
+
+        def spying(rel):
+            got = orig(rel)
+            if got is None:
+                return None
+            stream, size = got
+
+            class Spy:
+                def read(self, n=-1):
+                    buf = stream.read(n)
+                    reads.append(len(buf))
+                    return buf
+
+                def close(self):
+                    stream.close()
+            return Spy(), size
+
+        worker.engine.open_document_stream = spying
+        try:
+            got = http_get(leader.url + "/leader/download?path=big.bin",
+                           timeout=60.0)
+        finally:
+            worker.engine.open_document_stream = orig
+        assert hashlib.sha256(got).hexdigest() == \
+            hashlib.sha256(blob).hexdigest()
+        # the worker handler pulled bounded chunks, never the whole file
+        assert reads and max(reads) <= (1 << 16)
+
+    def test_pdf_upload_extracts_binary_upload_415(self, cluster):
+        """Tika-parity contract over HTTP (Worker.java:198-212): a PDF
+        becomes searchable text; a raw binary is refused with 415."""
+        import urllib.error
+
+        leader = cluster[0]
+        pdf_stream = b"BT (uniquepdftoken inside document) Tj ET"
+        pdf = (b"%PDF-1.4\nstream\n" + pdf_stream + b"endstream\n%%EOF")
+        http_post(leader.url + "/leader/upload?name=doc.pdf", pdf,
+                  content_type="application/octet-stream")
+        res = json.loads(http_post(leader.url + "/leader/start",
+                                   b"uniquepdftoken"))
+        assert set(res) == {"doc.pdf"}
+        elf = b"\x7fELF\x02\x01\x01" + bytes(64)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(leader.url + "/leader/upload?name=prog.bin", elf,
+                      content_type="application/octet-stream")
+        assert ei.value.code == 415
 
     def test_multipart_upload(self, cluster):
         leader = cluster[0]

@@ -146,6 +146,66 @@ class TestCompaction:
         assert [h.name for h in e.search("delta")] == ["b.txt"]
 
 
+class TestTieredMerging:
+    def test_big_segments_not_rewritten(self, tmp_path):
+        """Tiered policy: over-cap merging takes the SMALLEST segments;
+        an established big segment object survives untouched."""
+        e = make_engine(tmp_path, "tier", "segments", max_segments=2)
+        for i in range(40):                      # one big segment
+            e.ingest_text(f"big{i}.txt", f"common word{i} filler text")
+        e.commit()
+        big = e.index.snapshot.segments[0]
+        for j in range(3):                       # small commits -> merges
+            e.ingest_text(f"small{j}.txt", f"tiny doc number{j}")
+            e.commit()
+        segs = e.index.snapshot.segments
+        assert len(segs) <= 2
+        assert any(s is big for s in segs), \
+            "the big segment must not be rewritten by small merges"
+        assert [h.name for h in e.search("number2")] == ["small2.txt"]
+        assert e.search("word7")[0].name == "big7.txt"
+
+    def test_background_merge_with_racing_delete(self, tmp_path):
+        """A merge above sync_merge_nnz runs off the commit path; a
+        delete landing while it builds is re-applied at splice time."""
+        e = make_engine(tmp_path, "bg", "segments", max_segments=1,
+                        sync_merge_nnz=1)        # force background path
+        e.ingest_text("a.txt", "alpha beta gamma")
+        e.commit()
+        e.ingest_text("b.txt", "delta alpha")
+        e.commit()                               # schedules background merge
+        idx = e.index
+        # racing write while the merge is (or was) in flight
+        e.delete("a.txt")
+        idx.wait_for_merges(timeout=30)
+        e.commit()
+        assert len(idx.snapshot.segments) == 1
+        assert e.search("alpha") and \
+            [h.name for h in e.search("alpha")] == ["b.txt"]
+        assert e.search("gamma") == []
+        # the index keeps matching a rebuild engine afterwards
+        reb = make_engine(tmp_path, "bg_reb", "rebuild")
+        reb.ingest_text("b.txt", "delta alpha")
+        reb.commit()
+        assert results(e) == results(reb)
+
+    def test_background_merge_upsert_away(self, tmp_path):
+        """An upsert that moves a doc to pending while its old segment
+        merges must not resurrect the old copy."""
+        e = make_engine(tmp_path, "bgu", "segments", max_segments=1,
+                        sync_merge_nnz=1)
+        e.ingest_text("a.txt", "original unique stuff")
+        e.commit()
+        e.ingest_text("b.txt", "second doc here")
+        e.commit()
+        e.ingest_text("a.txt", "replacement totally different")
+        e.index.wait_for_merges(timeout=30)
+        e.commit()
+        assert e.search("original") == []
+        assert [h.name for h in e.search("replacement")] == ["a.txt"]
+        assert [h.name for h in e.search("second")] == ["b.txt"]
+
+
 class TestCheckpointStreaming:
     def test_checkpoint_roundtrip_segments(self, tmp_path):
         from tfidf_tpu.engine.checkpoint import (load_checkpoint,

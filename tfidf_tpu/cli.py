@@ -112,9 +112,21 @@ def cmd_ingest(args) -> int:
     from tfidf_tpu.engine.checkpoint import save_checkpoint
     from tfidf_tpu.engine.engine import Engine
 
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
     cfg = _load_cfg(args)
     engine = Engine(cfg)
     n = 0
+
+    def ingest_one(name: str, data: bytes, save: bool) -> int:
+        try:
+            engine.ingest_bytes(name, data, save_to_disk=save)
+            return 1
+        except UnsupportedMediaType as e:
+            # one stray binary must not abort a directory ingest
+            print(f"skipping {name}: {e}", file=sys.stderr)
+            return 0
+
     for path in args.paths:
         if os.path.isdir(path):
             # ingest files only; one commit at the end covers everything
@@ -123,13 +135,10 @@ def cmd_ingest(args) -> int:
                     full = os.path.join(dirpath, fn)
                     rel = os.path.relpath(full, path)
                     with open(full, "rb") as f:
-                        engine.ingest_bytes(rel, f.read())
-                    n += 1
+                        n += ingest_one(rel, f.read(), False)
         else:
             with open(path, "rb") as f:
-                engine.ingest_bytes(os.path.basename(path), f.read(),
-                                    save_to_disk=True)
-            n += 1
+                n += ingest_one(os.path.basename(path), f.read(), True)
     engine.commit()
     if args.checkpoint:
         save_checkpoint(engine, args.checkpoint)

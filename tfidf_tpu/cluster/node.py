@@ -47,6 +47,7 @@ from tfidf_tpu.cluster.batcher import QueryBatcher
 from tfidf_tpu.cluster.election import LeaderElection
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
 from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.ops.analyzer import UnsupportedMediaType
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
@@ -368,35 +369,69 @@ class SearchNode:
             est[w] = est.get(w, 0) + len(d.get("text", ""))
         placed = {}
         errors = {}
+        skipped: list[dict] = []
         for w, group in per_worker.items():
             try:
-                http_post(w + "/worker/upload-batch",
-                          json.dumps(group).encode(), timeout=300.0)
+                resp = json.loads(http_post(
+                    w + "/worker/upload-batch",
+                    json.dumps(group).encode(), timeout=300.0))
             except Exception as e:
                 errors[w] = repr(e)
                 continue
-            placed[w] = len(group)
+            # the worker reports per-doc UnsupportedMediaType skips —
+            # those names were NOT indexed and must not enter the
+            # placement map or the placed counts
+            w_skipped = {s["name"] for s in resp.get("skipped", ())}
+            skipped.extend(resp.get("skipped", ()))
+            placed[w] = len(group) - len(w_skipped)
             for d in group:
+                if d["name"] in w_skipped:
+                    continue
                 self._placement[d["name"]] = w
                 sizes[w] = sizes.get(w, 0) + len(d.get("text", ""))
-            global_metrics.inc("uploads_placed", len(group))
+            global_metrics.inc("uploads_placed", placed[w])
         if errors and not placed:
             raise RuntimeError(f"all workers failed: {errors}")
-        return {"placed": placed, **({"errors": errors} if errors else {})}
+        out = {"placed": placed}
+        if skipped:
+            out["skipped"] = skipped
+        if errors:
+            out["errors"] = errors
+        return out
 
-    def leader_download(self, rel: str) -> bytes | None:
-        """Serve from local disk, else probe every worker and proxy the
-        first hit (``Leader.java:95-151``)."""
-        data = self.engine.open_document(rel)
-        if data is not None:
-            return data
+    def leader_download_stream(self, rel: str):
+        """Locate a document and return a readable stream + size for
+        chunked proxying: local disk first, else probe every worker and
+        stream the first hit through (``Leader.java:95-151`` serves
+        ``FileSystemResource`` streams; buffering whole files per
+        request would hold a thread's memory hostage at GB scale).
+
+        Returns ``(fileobj, size | None)`` or ``None``; the caller owns
+        closing the fileobj."""
+        local = self.engine.open_document_stream(rel)
+        if local is not None:
+            return local
         q = urllib.parse.quote(rel)
         for w in self.registry.get_all_service_addresses():
             try:
-                return http_get(w + f"/worker/download?path={q}")
+                resp = urllib.request.urlopen(
+                    w + f"/worker/download?path={q}", timeout=30.0)
+                size = resp.headers.get("Content-Length")
+                return resp, (int(size) if size is not None else None)
             except Exception:
                 continue   # first 2xx wins; probe the next (Leader.java:144)
         return None
+
+    def leader_download(self, rel: str) -> bytes | None:
+        """Buffered convenience wrapper over the streaming path."""
+        got = self.leader_download_stream(rel)
+        if got is None:
+            return None
+        stream, _size = got
+        try:
+            return stream.read()
+        finally:
+            stream.close()
 
 
 class _NodeHandler(BaseHTTPRequestHandler):
@@ -464,14 +499,14 @@ class _NodeHandler(BaseHTTPRequestHandler):
             elif u.path == "/leader/download":
                 rel = urllib.parse.unquote(self._query_param(u, "path") or "")
                 try:
-                    data = node.leader_download(rel)
+                    got = node.leader_download_stream(rel)
                 except PermissionError:
                     self._text("invalid path", 400)
                     return
-                if data is None:
+                if got is None:
                     self._text("not found", 404)
                 else:
-                    self._send(200, data, "application/octet-stream")
+                    self._stream(*got)
             elif u.path == "/api/status":
                 # same phrasing as Controllers.java:25-29
                 self._text("I am the leader" if node.is_leader()
@@ -512,24 +547,37 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 # the commit is deferred to the next search (NRT policy,
                 # see SearchNode.commit_if_dirty) — the raw file is
                 # already durable on disk at this point
-                node.engine.ingest_bytes(name, data, save_to_disk=True)
+                try:
+                    node.engine.ingest_bytes(name, data,
+                                             save_to_disk=True)
+                except UnsupportedMediaType as e:
+                    # the Tika-parity contract: extract or refuse loudly,
+                    # never index binary bytes as mojibake
+                    self._text(f"unsupported media type: {e}", 415)
+                    return
                 node.notify_write()
                 self._text(f"File {name} uploaded and indexed")
             elif u.path == "/worker/upload-batch":
                 docs = json.loads(self._body().decode("utf-8"))
                 global_injector.check("worker.upload")
+                skipped = []
                 try:
                     for d in docs:
-                        node.engine.ingest_bytes(
-                            d["name"], d["text"].encode("utf-8"),
-                            save_to_disk=True)
+                        try:
+                            node.engine.ingest_bytes(
+                                d["name"], d["text"].encode("utf-8"),
+                                save_to_disk=True)
+                        except UnsupportedMediaType as e:
+                            skipped.append({"name": d["name"],
+                                            "error": str(e)})
                 finally:
                     # mark dirty even on a mid-batch failure: the docs
                     # already ingested must become searchable at the
                     # next NRT flush, not be stranded uncommitted
                     if docs:
                         node.notify_write()
-                self._text(f"{len(docs)} files uploaded and indexed")
+                self._json({"indexed": len(docs) - len(skipped),
+                            "skipped": skipped})
             elif u.path == "/leader/upload-batch":
                 docs = json.loads(self._body().decode("utf-8"))
                 self._json(node.leader_upload_batch(docs))
@@ -541,7 +589,13 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 if not name:
                     self._text("missing file name", 400)
                     return
-                result = node.leader_upload(name, data)
+                try:
+                    result = node.leader_upload(name, data)
+                except urllib.error.HTTPError as e:
+                    if e.code == 415:   # worker refused a binary format
+                        self._text("unsupported media type", 415)
+                        return
+                    raise
                 self._text(f"File uploaded successfully to worker: "
                            f"{result['worker']}")
             else:
@@ -550,16 +604,58 @@ class _NodeHandler(BaseHTTPRequestHandler):
             log.warning("request failed", path=u.path, err=repr(e))
             self._text(f"error: {e!r}", 500)
 
+    _STREAM_CHUNK = 1 << 16
+
+    def _stream(self, stream, size: int | None) -> None:
+        """Chunked-copy a readable stream to the client with constant
+        memory (Content-Length when known, else chunked encoding).
+
+        Once the 200 status line is on the wire a failure can no longer
+        become a 500 — writing another status line would inject bytes
+        into the declared payload and hand the client a silently
+        truncated-then-corrupted file. Mid-stream errors instead ABORT
+        the connection (close without the terminating chunk / short of
+        Content-Length), which every HTTP client detects as a transfer
+        error."""
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            chunked = size is None
+            if chunked:
+                self.send_header("Transfer-Encoding", "chunked")
+            else:
+                self.send_header("Content-Length", str(size))
+            self.end_headers()
+            try:
+                while True:
+                    buf = stream.read(self._STREAM_CHUNK)
+                    if not buf:
+                        break
+                    if chunked:
+                        self.wfile.write(b"%x\r\n" % len(buf))
+                        self.wfile.write(buf)
+                        self.wfile.write(b"\r\n")
+                    else:
+                        self.wfile.write(buf)
+                if chunked:
+                    self.wfile.write(b"0\r\n\r\n")
+            except Exception as e:
+                log.warning("download stream aborted mid-transfer",
+                            err=repr(e))
+                self.close_connection = True
+        finally:
+            stream.close()
+
     def _download_from_engine(self, u) -> None:
         # URL-decode + traversal check live in Engine._safe_doc_path
         # (Worker.java:97-121 parity)
         rel = urllib.parse.unquote(self._query_param(u, "path") or "")
         try:
-            data = self.node.engine.open_document(rel)
+            got = self.node.engine.open_document_stream(rel)
         except PermissionError:
             self._text("invalid path", 400)
             return
-        if data is None:
+        if got is None:
             self._text("not found", 404)
         else:
-            self._send(200, data, "application/octet-stream")
+            self._stream(*got)

@@ -116,13 +116,14 @@ def load_checkpoint(directory: str, config: Config | None = None) -> Engine:
     term_ids = data["term_ids"]
     tfs = data["tfs"]
     lengths = data["lengths"]
+    # bulk restore: feed the packed arrays straight through the
+    # array-ingest path — docs.npz already stores exactly what
+    # add_document_arrays wants; replaying through per-doc dict
+    # construction cost minutes at 1M docs (VERDICT r2 #8a)
+    add = engine.index.add_document_arrays
     for i, name in enumerate(names):
         lo, hi = int(offsets[i]), int(offsets[i + 1])
-        engine.index.add_document(
-            name,
-            dict(zip(term_ids[lo:hi].tolist(),
-                     tfs[lo:hi].astype(np.int64).tolist())),
-            length=float(lengths[i]))
+        add(name, term_ids[lo:hi], tfs[lo:hi], float(lengths[i]))
     engine.commit()
     log.info("checkpoint loaded", dir=directory, docs=len(names))
     return engine

@@ -20,7 +20,8 @@ from tfidf_tpu.engine.segments import SegmentedIndex
 from tfidf_tpu.engine.searcher import Searcher, SearchHit
 from tfidf_tpu.engine.vocab import NativeVocabulary, Vocabulary
 from tfidf_tpu.models.base import get_model
-from tfidf_tpu.ops.analyzer import Analyzer, extract_text
+from tfidf_tpu.ops.analyzer import (Analyzer, UnsupportedMediaType,
+                                    extract_text)
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.logging import Stopwatch, get_logger
 from tfidf_tpu.utils.tracing import trace_phase
@@ -109,7 +110,8 @@ class Engine:
                 self.model,
                 min_doc_cap=c.min_doc_capacity,
                 ell_width_cap=c.ell_width_cap,
-                max_segments=c.max_segments)
+                max_segments=c.max_segments,
+                sync_merge_nnz=c.sync_merge_nnz)
         else:
             self.index = ShardIndex(
                 self.model,
@@ -175,6 +177,11 @@ class Engine:
                         with open(full, "rb") as f:
                             self.ingest_text(rel, extract_text(f.read()))
                         n += 1
+                    except UnsupportedMediaType as e:
+                        # a stray binary in the documents dir must not
+                        # kill recovery-by-rebuild
+                        log.warning("skipping unsupported file",
+                                    path=full, err=str(e))
                     except OSError as e:  # unreadable file: skip, like walk
                         log.warning("skipping unreadable file",
                                     path=full, err=str(e))
@@ -209,6 +216,16 @@ class Engine:
             return None
         with open(path, "rb") as f:
             return f.read()
+
+    def open_document_stream(self, rel: str):
+        """(file object, size) for chunked transfer, or None — the
+        streaming analog of :meth:`open_document` (the reference serves
+        ``FileSystemResource`` streams, ``Worker.java:97-121``; a
+        GB-scale document must not be buffered whole per request)."""
+        path = self._safe_doc_path(rel)
+        if not os.path.isfile(path):
+            return None
+        return open(path, "rb"), os.path.getsize(path)
 
     # ---- load metric ----
 

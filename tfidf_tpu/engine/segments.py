@@ -16,9 +16,16 @@ This module mirrors how Lucene actually handles that
   tombstoned doc still counts in df until merge. The device live mask is
   owned by the published *snapshot*, not the shared Segment, so searches
   against an old snapshot never observe later deletes mid-batch;
-* **compaction** merges all segments into one when the segment count
-  exceeds ``max_segments`` (a simple TieredMergePolicy stand-in),
-  reclaiming tombstones and re-tightening df;
+* **merging** is tiered, Lucene-TieredMergePolicy style: when the
+  segment count exceeds ``max_segments``, the SMALLEST similar-sized
+  segments merge into one (reclaiming their tombstones and
+  re-tightening df) while big segments are left alone — each document
+  is rewritten O(log corpus) times over its life instead of on every
+  compaction. Small merges run inline on commit; merges above
+  ``sync_merge_nnz`` run on a background thread and splice in under the
+  write lock when ready (deletes/upserts that raced the merge are
+  re-applied at swap time), so commit latency stays O(new docs) with no
+  O(corpus) spikes on the write path;
 * queries score EVERY segment with the **current** global statistics
   (df summed over segments, live doc count, live avgdl) — weights are
   computed in-kernel (:func:`tfidf_tpu.ops.ell.score_segment_ell`), the
@@ -71,6 +78,7 @@ class Segment:
     res_term: jax.Array | None
     res_doc: jax.Array | None
     doc_len_d: jax.Array | None  # f32 [doc_cap] transformed (residual path)
+    nnz_total: int = 0    # host postings entries (merge-tier sizing)
     live: np.ndarray = field(default=None)  # bool [n_docs] host mirror
 
     @property
@@ -145,20 +153,31 @@ class SegmentedIndex:
                  min_doc_cap: int = 1024,
                  layout: str = "ell",            # segments are always ELL
                  ell_width_cap: int = 256,
-                 max_segments: int = 8) -> None:
+                 max_segments: int = 8,
+                 sync_merge_nnz: int = 1 << 20) -> None:
         self.model = model
         self.min_doc_cap = min_doc_cap
         self.ell_width_cap = ell_width_cap
         self.max_segments = max_segments
+        # merges whose combined postings exceed this run on the
+        # background thread instead of the commit critical path
+        self.sync_merge_nnz = sync_merge_nnz
         self._write_lock = threading.Lock()
         self._pending: list[DocEntry] = []
         self._segments: list[Segment] = []
-        # name -> (segment idx | -1 for pending, local idx)
-        self._where: dict[str, tuple[int, int]] = {}
+        # name -> (Segment | None for pending, local idx); object refs,
+        # not indices, so background merges can splice the segment list
+        # without rewriting every entry
+        self._where: dict[str, tuple[Segment | None, int]] = {}
         self._gen = 1
         self._committed_gen = 0
         self._version = 0
         self.snapshot: SegmentedSnapshot | None = None
+        # background merge state: at most one in flight; its source
+        # segments are excluded from further merge selection
+        self._merge_pool = None
+        self._merge_sources: list[Segment] | None = None
+        self._merge_future = None
 
     # ---- write path ----
 
@@ -183,7 +202,7 @@ class SegmentedIndex:
             length=float(length if length is not None else tfs.sum()))
         with self._write_lock:
             self._tombstone_locked(name)
-            self._where[name] = (-1, len(self._pending))
+            self._where[name] = (None, len(self._pending))
             self._pending.append(entry)
             self._gen += 1
         global_metrics.inc("docs_indexed")
@@ -200,11 +219,10 @@ class SegmentedIndex:
         loc = self._where.get(name)
         if loc is None:
             return False
-        seg_i, local = loc
-        if seg_i == -1:
+        seg, local = loc
+        if seg is None:
             self._pending[local].live = False
         else:
-            seg = self._segments[seg_i]
             seg.live[local] = False
             # the host mirror is the only thing mutated here; device masks
             # are built per published snapshot at the next commit, so
@@ -305,7 +323,7 @@ class SegmentedIndex:
             doc_cap=doc_cap, names=[d.name for d in entries],
             df=df, raw_len=raw_len, host_docs=entries,
             res_tf=res_tf, res_term=res_term, res_doc=res_doc,
-            doc_len_d=doc_len_d,
+            doc_len_d=doc_len_d, nnz_total=nnz,
             live=np.ones(n, bool))
 
     def _cosine_norms_real(self, seg: Segment, df_total: np.ndarray,
@@ -363,10 +381,10 @@ class SegmentedIndex:
             self._pending = []
             if new_seg is not None:
                 for local, d in enumerate(new_seg.host_docs):
-                    self._where[d.name] = (len(self._segments), local)
+                    self._where[d.name] = (new_seg, local)
                 self._segments.append(new_seg)
             if len(self._segments) > self.max_segments:
-                self._compact_locked(vocab_cap)
+                self._merge_policy_locked(vocab_cap)
             segments = list(self._segments)
 
             # Global stats over the CURRENT segment set. Both df and the
@@ -409,21 +427,119 @@ class SegmentedIndex:
                  segments=len(segments), docs=live_count)
         return snap
 
-    def _compact_locked(self, vocab_cap: int) -> None:
-        """Merge all segments into one, dropping tombstones (the merge
-        policy: simple full compaction when over max_segments)."""
-        entries: list[DocEntry] = []
-        for seg in self._segments:
-            entries.extend(d for d, alive in zip(seg.host_docs, seg.live)
-                           if alive)
-        self._segments = []
-        if entries:
-            seg = self._build_segment(entries, vocab_cap)
-            for local, d in enumerate(seg.host_docs):
-                self._where[d.name] = (0, local)
-            self._segments = [seg]
+    # ---- tiered merging (Lucene TieredMergePolicy shape) ----
+
+    def _merge_policy_locked(self, vocab_cap: int) -> None:
+        """Pick the SMALLEST similar-sized segments and merge just
+        enough of them to get back under ``max_segments``; big segments
+        are not rewritten. Small merges run inline; big ones go to the
+        background thread (one in flight), during which the segment
+        count may transiently exceed the cap."""
+        while len(self._segments) > self.max_segments:
+            busy = set(map(id, self._merge_sources or ()))
+            avail = [s for s in self._segments if id(s) not in busy]
+            need = len(self._segments) - self.max_segments + 1
+            if len(avail) < max(need, 2):
+                return                      # background merge will catch up
+            by_size = sorted(avail, key=lambda s: s.nnz_total)
+            merge_set = by_size[:max(need, 2)]
+            # extend only across the SAME size tier: the next candidate
+            # must be within 8x of the largest segment already merging.
+            # (Comparing against the running sum would cascade a ladder
+            # of near-equal segments into full compaction — each doc
+            # would be rewritten O(n) times instead of O(log n).)
+            total = sum(s.nnz_total for s in merge_set)
+            tier_cap = 8 * max(merge_set[-1].nnz_total, 1)  # FIXED bound
+            for s in by_size[len(merge_set):]:
+                if s.nnz_total <= tier_cap:
+                    merge_set.append(s)
+                    total += s.nnz_total
+                else:
+                    break
+            if total > self.sync_merge_nnz:
+                if self._merge_future is None:
+                    self._start_background_merge_locked(merge_set,
+                                                        vocab_cap)
+                # an over-threshold merge NEVER runs on the commit path;
+                # while one is already in flight the segment count floats
+                # above the cap until it splices (Lucene's merge
+                # backpressure behaves the same way)
+                return
+            self._merge_inline_locked(merge_set, vocab_cap)
+
+    def _merge_entries(self, sources: list[Segment]) -> list[DocEntry]:
+        return [d for seg in sources
+                for d, alive in zip(seg.host_docs, seg.live) if alive]
+
+    def _splice_locked(self, sources: list[Segment],
+                       merged: Segment | None) -> None:
+        """Replace ``sources`` with ``merged`` (at the first source's
+        position), re-pointing ``_where`` for documents STILL owned by a
+        source — a doc deleted or upserted away since the merge began is
+        tombstoned in the merged copy instead (its postings die with
+        the next merge, exactly like any tombstone)."""
+        src = set(map(id, sources))
+        pos = min(i for i, s in enumerate(self._segments)
+                  if id(s) in src)
+        self._segments = (
+            self._segments[:pos]
+            + ([merged] if merged is not None else [])
+            + [s for s in self._segments[pos:] if id(s) not in src])
+        if merged is not None:
+            for local, d in enumerate(merged.host_docs):
+                loc = self._where.get(d.name)
+                if loc is not None and loc[0] is not None \
+                        and id(loc[0]) in src:
+                    self._where[d.name] = (merged, local)
+                else:
+                    merged.live[local] = False
         global_metrics.inc("compactions")
-        log.info("compacted segments", docs=len(entries))
+
+    def _merge_inline_locked(self, sources: list[Segment],
+                             vocab_cap: int) -> None:
+        entries = self._merge_entries(sources)
+        merged = self._build_segment(entries, vocab_cap) if entries \
+            else None
+        self._splice_locked(sources, merged)
+        log.info("merged segments", merged=len(sources),
+                 docs=len(entries), mode="inline")
+
+    def _start_background_merge_locked(self, sources: list[Segment],
+                                       vocab_cap: int) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+        if self._merge_pool is None:
+            self._merge_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="segment-merge")
+        self._merge_sources = sources
+        entries = self._merge_entries(sources)
+
+        def run():
+            try:
+                # the heavy host+device build happens WITHOUT the lock;
+                # sources stay queryable the whole time
+                merged = (self._build_segment(entries, vocab_cap)
+                          if entries else None)
+                with self._write_lock:
+                    self._splice_locked(sources, merged)
+                    self._merge_sources = None
+                    self._merge_future = None
+                    self._gen += 1      # next commit publishes the swap
+                log.info("merged segments", merged=len(sources),
+                         docs=len(entries), mode="background")
+            except Exception as e:      # keep serving on failure
+                with self._write_lock:
+                    self._merge_sources = None
+                    self._merge_future = None
+                log.warning("background merge failed", err=repr(e))
+
+        self._merge_future = self._merge_pool.submit(run)
+
+    def wait_for_merges(self, timeout: float | None = None) -> None:
+        """Block until any in-flight background merge has spliced (test
+        and shutdown hook)."""
+        fut = self._merge_future
+        if fut is not None:
+            fut.result(timeout=timeout)
 
     def doc_name(self, gid: int) -> str:
         assert self.snapshot is not None

@@ -277,7 +277,7 @@ def make_mesh_ell_search(mesh: Mesh,
     delta slot. Global stats arrive precomputed (the engine refreshes
     them at commit), so the step needs no df psum.
 
-    ``packed=True`` returns ONE f32 ``[B, 2k]`` array (ids bitcast) so
+    ``packed=True`` returns ONE i32 ``[B, 2k]`` array (values bitcast) so
     the caller fetches values and ids in a single device->host transfer
     — on high-latency links (remote-TPU tunnels) the second fetch costs
     a full RTT, which at k=10 dwarfs the payload.

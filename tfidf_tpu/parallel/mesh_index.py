@@ -338,8 +338,10 @@ class MeshSearcher(QueryVectorizerMixin):
     """Query execution against MeshSnapshots — the distributed forward
     pass. Mirrors :class:`~tfidf_tpu.engine.searcher.Searcher`'s interface
     so Engine/cluster code is layout-agnostic. Subclasses (the ELL mesh
-    layout) override only :meth:`_topk_chunk` / :meth:`_search_unbounded`
-    — the chunking and hit-assembly loop lives in one place."""
+    layout) override only the hooks — :meth:`_dispatch_chunk`,
+    :meth:`_finish_chunk`, :meth:`_search_unbounded`,
+    :meth:`_on_snapshot` — the chunking and hit-assembly loop lives in
+    one place."""
 
     def __init__(self, index: MeshIndex, analyzer, vocab,
                  model: ScoringModel,

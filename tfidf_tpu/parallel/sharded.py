@@ -298,7 +298,7 @@ def make_sharded_search(mesh: Mesh,
             jnp.asarray(q.uniq), jnp.asarray(q.n_uniq),
             jnp.asarray(q.slots), jnp.asarray(q.weights))
         if packed:
-            # one [B, 2k] f32 buffer: values + bitcast ids fetched in a
+            # one [B, 2k] i32 buffer: bitcast values + ids fetched in a
             # single device->host transfer (the second fetch costs a full
             # RTT on tunneled links)
             return pack_topk(vals, gids)

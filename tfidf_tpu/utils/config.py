@@ -106,9 +106,12 @@ class Config:
     # --- index mode ---
     # "rebuild": every commit re-lays-out the whole corpus (static corpora)
     # "segments": Lucene-style streaming segments — commit is O(new docs),
-    #             tombstone deletes, compaction above max_segments
+    #             tombstone deletes, tiered merging above max_segments
+    #             (merges with more than sync_merge_nnz postings run on a
+    #             background thread, off the commit critical path)
     index_mode: str = "rebuild"
     max_segments: int = 8
+    sync_merge_nnz: int = 1 << 20
 
     # --- ingest ---
     # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back

@@ -145,6 +145,21 @@ def test_extract_zip_without_docx_rejected():
         extract_text(buf.getvalue())
 
 
+def test_valid_utf8_binary_rejected():
+    """NUL-padded archives are valid UTF-8 — the density gate must run
+    on every decode branch, not just the latin-1 fallback."""
+    import pytest
+
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
+    tarish = b"some/path\x00" + b"\x00" * 500 + b"0000644\x00ustar"
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(tarish)
+    # lossy client-side decodes surface as U+FFFD runs — same verdict
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(("�" * 300 + "PNG data").encode("utf-8"))
+
+
 def test_plain_text_mentioning_html_not_stripped():
     txt = ("wrap the page in an <html> element and a <body> tag; "
            "generics like List<int> must survive too").encode()

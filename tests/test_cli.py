@@ -118,6 +118,18 @@ class TestClusterClientCommands:
             st = json.loads(out)
             assert st["status"] == "I am the leader"
             assert st["services"] == [nodes[1].url]
+
+            # bulk: a directory of text files in one batched request
+            bdir = tmp_path / "bulk"
+            bdir.mkdir()
+            for i in range(5):
+                (bdir / f"b{i}.txt").write_text(f"okapi spots item{i}")
+            rc, out = run_cli(capsys, "upload", str(bdir),
+                              "--leader", leader.url, "--batch")
+            assert rc == 0 and "5 files uploaded" in out
+            rc, out = run_cli(capsys, "query", "item3",
+                              "--leader", leader.url)
+            assert "b3.txt" in json.loads(out)
         finally:
             for n in nodes:
                 try:

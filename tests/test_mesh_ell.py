@@ -207,6 +207,13 @@ class TestIncrementalStats:
         assert inc[1] == scr[1], "live count"
         assert abs(inc[2] - scr[2]) < 1e-6, "length sum"
         np.testing.assert_array_equal(inc[0], scr[0])
+        # the DEVICE-resident replicated df (maintained by journaled
+        # sparse scatters between rebuilds) must match the host truth
+        snap = e.index.snapshot
+        if snap is not None and not e.index._df_journal:
+            dev = np.asarray(snap.df_g)
+            want, _n, _l = e.index._live_stats(dev.shape[0])
+            np.testing.assert_array_equal(dev, want)
 
     def test_stats_track_mutations(self, tmp_path):
         e = make_engine(tmp_path, "inc", "mesh")

@@ -131,3 +131,35 @@ def test_full_ranking_orders_all():
     scores = jnp.asarray([[1.0, 3.0, 2.0, 0.0]])
     vals, ids = full_ranking(scores, 4)
     assert ids[0].tolist() == [1, 2, 0, 3]
+
+
+def test_pack_topk_roundtrip_small_ids():
+    """The wire buffer must survive ids < 2^23 exactly — as f32 those
+    bit patterns are denormals and real hardware flushed them to zero
+    (the round-3 wire bug); the packed dtype is integer for this
+    reason."""
+    from tfidf_tpu.ops.topk import pack_topk, unpack_topk
+
+    ids = jnp.asarray([[0, 1, 7, 4096, 99089, (1 << 23) - 1, 1 << 23]],
+                      jnp.int32)
+    vals = jnp.asarray([[0.5, -1.0, 1e-38, 3.14, 0.0, 2.0, -0.25]],
+                       jnp.float32)
+    out = pack_topk(vals, ids)
+    assert out.dtype == jnp.int32
+    v, i = unpack_topk(out)
+    np.testing.assert_array_equal(i, np.asarray(ids))
+    np.testing.assert_array_equal(v, np.asarray(vals))
+
+
+def test_packed_topk_chunked_matches_plain(rng):
+    from tfidf_tpu.ops.topk import (packed_topk, packed_topk_chunked,
+                                    unpack_topk)
+
+    scores = jnp.asarray(rng.normal(size=(3, 4096)).astype(np.float32))
+    num = jnp.int32(4000)            # tail is padding, must be masked
+    v0, i0 = unpack_topk(packed_topk(scores, num, k=7))
+    v1, i1 = unpack_topk(packed_topk_chunked(scores, num, k=7,
+                                             chunk=512))
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)
+    np.testing.assert_array_equal(i0, i1)
+    assert (np.asarray(i1) < 4000).all()

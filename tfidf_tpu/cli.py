@@ -172,6 +172,52 @@ def _leader_url(args) -> str:
 def cmd_upload(args) -> int:
     from tfidf_tpu.cluster.node import http_post
 
+    if getattr(args, "batch", False):
+        from tfidf_tpu.ops.analyzer import (UnsupportedMediaType,
+                                            extract_text)
+
+        # bulk path: expand dirs (relative paths as names, same keying
+        # as cmd_ingest — basenames would silently upsert same-named
+        # files from different subdirectories over each other), extract
+        # text CLIENT-side (the Tika contract: binaries are refused
+        # here, not lossily decoded past the worker's 415 gate), ship
+        # one /leader/upload-batch request per chunk of 500
+        files: list[tuple[str, str]] = []     # (name, path)
+        for path in args.files:
+            if os.path.isdir(path):
+                for dirpath, _d, fns in sorted(os.walk(path)):
+                    files.extend(
+                        (os.path.relpath(os.path.join(dirpath, fn),
+                                         path), os.path.join(dirpath, fn))
+                        for fn in sorted(fns))
+            else:
+                files.append((os.path.basename(path), path))
+        total = 0
+        failed = False
+        for lo in range(0, len(files), 500):
+            docs = []
+            for name, p in files[lo:lo + 500]:
+                with open(p, "rb") as f:
+                    raw = f.read()
+                try:
+                    docs.append({"name": name,
+                                 "text": extract_text(raw)})
+                except UnsupportedMediaType as e:
+                    print(f"skipped {name}: {e}", file=sys.stderr)
+            if not docs:
+                continue
+            resp = json.loads(http_post(
+                _leader_url(args) + "/leader/upload-batch",
+                json.dumps(docs).encode()))
+            total += sum(resp.get("placed", {}).values())
+            for s in resp.get("skipped", ()):
+                print(f"skipped {s['name']}: {s['error']}",
+                      file=sys.stderr)
+            for w, err in resp.get("errors", {}).items():
+                print(f"worker {w} failed: {err}", file=sys.stderr)
+                failed = True
+        print(f"{total} files uploaded and indexed")
+        return 1 if failed else 0
     for path in args.files:
         with open(path, "rb") as f:
             data = f.read()
@@ -266,6 +312,9 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("upload", help="upload documents to a cluster")
     s.add_argument("files", nargs="+")
     s.add_argument("--leader", required=True, help="leader base URL")
+    s.add_argument("--batch", action="store_true",
+                   help="bulk-ingest text files (dirs expand; one "
+                        "upload-batch request per 500 docs)")
     s.set_defaults(fn=cmd_upload)
 
     s = sub.add_parser("query", help="search a running cluster")

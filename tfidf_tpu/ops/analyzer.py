@@ -217,20 +217,24 @@ def extract_text(data: bytes) -> str:
         except UnicodeDecodeError:
             text = None
     if text is None:
-        # Latin-1 never fails; but a blob that is substantially control
-        # bytes is binary, not text in an unknown charset — reject it
-        # rather than index noise
-        sample = data[:4096]
-        n_ctrl = sum(1 for b in sample
-                     if b < 9 or (13 < b < 32) or b == 127)
-        if sample and n_ctrl / len(sample) > 0.10:
-            raise UnsupportedMediaType(
-                "undecodable bytes with high control-character density")
         text = data.decode("latin-1")
-        text = "".join(
-            ch if ch in "\t\n\r"
-            or not unicodedata.category(ch).startswith("C") else " "
-            for ch in text)
+    # a blob that is substantially control characters (or U+FFFD from a
+    # lossy client-side decode) is binary, not text — reject it rather
+    # than index noise. This guards EVERY decode branch: NUL-padded
+    # archives are valid UTF-8, so checking only the fallback path would
+    # let them through (tar's magic sits at offset 257, past any magic
+    # list).
+    sample = text[:4096]
+    n_ctrl = sum(1 for ch in sample
+                 if (ch < "\t") or ("\r" < ch < " ") or ch == "\x7f"
+                 or ch == "�")
+    if sample and n_ctrl / len(sample) > 0.10:
+        raise UnsupportedMediaType(
+            "text with high control-character density (binary content)")
+    text = "".join(
+        ch if ch in "\t\n\r"
+        or not unicodedata.category(ch).startswith("C") else " "
+        for ch in text)
     # HTML only when the document STARTS as HTML — a plain-text file
     # merely mentioning "<html" must not get its angle brackets stripped
     head = text[:512].lstrip("﻿ \t\r\n").lower()

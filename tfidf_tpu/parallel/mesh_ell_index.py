@@ -99,6 +99,13 @@ class MeshEllIndex(MeshIndex):
         self._df_live = np.zeros(0, np.float64)
         self._n_live_stat = 0
         self._len_sum_stat = 0.0
+        # journal of df changes since the last commit: (term_ids, sign)
+        # pairs, O(1) per mutation — the commit applies them as ONE
+        # sparse on-device scatter into the replicated df instead of
+        # re-uploading the whole [vocab_cap] array (2MB at 500k terms,
+        # the dominant steady-commit cost on high-latency links)
+        self._df_journal: list[tuple[np.ndarray, float]] = []
+        self._df_update_fns: dict[int, object] = {}
 
     # ---- incremental stats bookkeeping ----
 
@@ -112,6 +119,7 @@ class MeshEllIndex(MeshIndex):
                 grown[:self._df_live.shape[0]] = self._df_live
                 self._df_live = grown
             np.add.at(self._df_live, ids, 1.0)
+            self._df_journal.append((ids, 1.0))
         self._n_live_stat += 1
         self._len_sum_stat += entry.length
 
@@ -119,6 +127,7 @@ class MeshEllIndex(MeshIndex):
         ids = entry.term_ids
         if ids.shape[0]:
             np.add.at(self._df_live, ids, -1.0)
+            self._df_journal.append((ids, -1.0))
         self._n_live_stat -= 1
         self._len_sum_stat -= entry.length
 
@@ -188,12 +197,20 @@ class MeshEllIndex(MeshIndex):
                     delta = self._empty_delta(vocab_cap)
             self._pending = {}
 
-            # live-corpus global stats, recomputed host-side (appends
-            # and deletes both move them; the base impacts are refreshed
-            # below so IDF never goes stale)
-            df_host, n_live, len_sum = self._live_stats(vocab_cap)
-            df_g = jax.device_put(
-                df_host, NamedSharding(self.mesh, P(None)))
+            # live-corpus global stats (appends and deletes both move
+            # them; the base impacts are refreshed below so IDF never
+            # goes stale). After a rebuild the replicated df is uploaded
+            # whole; otherwise the journaled changes land as one sparse
+            # on-device scatter (O(touched terms), not O(vocab)).
+            if need_rebuild or self.snapshot is None:
+                df_host, n_live, len_sum = self._live_stats(vocab_cap)
+                df_g = jax.device_put(
+                    df_host, NamedSharding(self.mesh, P(None)))
+            else:
+                df_g = self._df_apply_journal(self.snapshot.df_g)
+                n_live = self._n_live_stat
+                len_sum = self._len_sum_stat
+            self._df_journal = []
             n_docs = jnp.float32(n_live)
             avgdl = jnp.float32(len_sum / n_live if n_live else 1.0)
             if self._refresh_fn is None:
@@ -235,6 +252,36 @@ class MeshEllIndex(MeshIndex):
         delta_docs = (len(self._placed) + len(pending)) - base_docs
         return (base_docs == 0
                 or delta_docs > self.delta_rebuild_frac * base_docs)
+
+    def _df_apply_journal(self, df_g):
+        """Fold the journaled df changes into the device-resident
+        replicated df with one padded sparse scatter (pad indices point
+        out of bounds and drop). Counts are integer-valued f32 adds —
+        exact; rebuilds resync from the host accumulators as a belt."""
+        if not self._df_journal:
+            return df_g
+        allids = np.concatenate([ids for ids, _ in self._df_journal])
+        signs = np.concatenate(
+            [np.full(ids.shape[0], s, np.float32)
+             for ids, s in self._df_journal])
+        uniq, inv = np.unique(allids, return_inverse=True)
+        dv = np.bincount(inv, weights=signs).astype(np.float32)
+        nz = dv != 0
+        uniq, dv = uniq[nz], dv[nz]
+        if uniq.shape[0] == 0:
+            return df_g
+        cap = next_capacity(int(uniq.shape[0]), 256)
+        idx = np.full(cap, df_g.shape[0], np.int32)
+        vals = np.zeros(cap, np.float32)
+        idx[:uniq.shape[0]] = uniq
+        vals[:uniq.shape[0]] = dv
+        fn = self._df_update_fns.get(cap)
+        if fn is None:
+            fn = jax.jit(
+                lambda df, i, v: df.at[i].add(v, mode="drop"),
+                out_shardings=NamedSharding(self.mesh, P(None)))
+            self._df_update_fns[cap] = fn
+        return fn(df_g, idx, vals)
 
     def _live_stats(self, vocab_cap: int):
         """O(vocab) snapshot of the incrementally-maintained live stats

@@ -104,6 +104,12 @@ def test_randomized_ops_match_oracle(tmp_path, mode):
         if hasattr(engine.index, "wait_for_merges"):
             engine.index.wait_for_merges(timeout=30)
             engine.commit()
+            # the incremental live counters must track the truth
+            # through upserts, deletes, and merges
+            assert engine.index.nnz_live == \
+                engine.index._nnz_live_scratch(), mode
+            assert engine.index.size_bytes() == \
+                engine.index._bytes_live_scratch(), mode
 
         queries = [" ".join(map(str, rng.choice(WORDS, size=2)))
                    for _ in range(4)]

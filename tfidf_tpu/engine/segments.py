@@ -80,6 +80,11 @@ class Segment:
     doc_len_d: jax.Array | None  # f32 [doc_cap] transformed (residual path)
     nnz_total: int = 0    # host postings entries (merge-tier sizing)
     live: np.ndarray = field(default=None)  # bool [n_docs] host mirror
+    # bumped on every tombstone: keys the per-segment view cache so an
+    # untouched segment's scoring view (and its device live mask) is
+    # REUSED across commits instead of rebuilt+re-uploaded
+    live_version: int = 0
+    view_cache: tuple | None = None   # (live_version, SegmentView)
 
     @property
     def n_docs(self) -> int:
@@ -90,9 +95,13 @@ class Segment:
 class SegmentedSnapshot:
     """What queries score against: the committed segment list + stats.
 
-    ``views`` are the scoring-ready pytrees; per-commit state (live masks,
-    cosine norms) lives here, never on the shared Segment objects, so an
-    in-flight search against an older snapshot keeps its own masks.
+    ``views`` are the scoring-ready pytrees. Per-commit state (live
+    masks, cosine norms) is IMMUTABLE once built: a view is owned by the
+    snapshots that reference it, and the version-keyed ``view_cache`` on
+    a Segment only ever reuses a view whose mask is bit-identical
+    (``live_version`` bumps on every tombstone force a rebuild) — so an
+    in-flight search against an older snapshot keeps its own masks, and
+    nothing may mutate a mask in place.
     """
     segments: list[Segment]
     views: tuple          # tuple of SegmentView, aligned with segments
@@ -178,6 +187,12 @@ class SegmentedIndex:
         self._merge_pool = None
         self._merge_sources: list[Segment] | None = None
         self._merge_future = None
+        # incremental live totals: nnz_live/size_bytes were O(corpus)
+        # host loops ON THE COMMIT PATH (and the index-size poll), which
+        # degraded sustained streaming rate as the corpus grew — these
+        # counters move only on mutation
+        self._nnz_live_stat = 0
+        self._bytes_live_stat = 0
 
     # ---- write path ----
 
@@ -204,6 +219,9 @@ class SegmentedIndex:
             self._tombstone_locked(name)
             self._where[name] = (None, len(self._pending))
             self._pending.append(entry)
+            self._nnz_live_stat += entry.term_ids.shape[0]
+            self._bytes_live_stat += (entry.term_ids.nbytes
+                                      + entry.tfs.nbytes)
             self._gen += 1
         global_metrics.inc("docs_indexed")
 
@@ -221,13 +239,18 @@ class SegmentedIndex:
             return False
         seg, local = loc
         if seg is None:
-            self._pending[local].live = False
+            entry = self._pending[local]
+            entry.live = False
         else:
+            entry = seg.host_docs[local]
             seg.live[local] = False
+            seg.live_version += 1
             # the host mirror is the only thing mutated here; device masks
             # are built per published snapshot at the next commit, so
             # committed searches keep seeing the pre-delete snapshot (an
             # uncommitted Lucene delete)
+        self._nnz_live_stat -= entry.term_ids.shape[0]
+        self._bytes_live_stat -= entry.term_ids.nbytes + entry.tfs.nbytes
         return True
 
     # ---- stats ----
@@ -238,13 +261,21 @@ class SegmentedIndex:
 
     @property
     def nnz_live(self) -> int:
+        return int(self._nnz_live_stat)
+
+    def size_bytes(self) -> int:
+        return int(self._bytes_live_stat)
+
+    def _nnz_live_scratch(self) -> int:
+        """Full recompute (test oracle for the incremental counter)."""
         n = sum(d.term_ids.shape[0] for d in self._pending if d.live)
         for seg in self._segments:
             n += sum(d.term_ids.shape[0]
                      for d, alive in zip(seg.host_docs, seg.live) if alive)
         return int(n)
 
-    def size_bytes(self) -> int:
+    def _bytes_live_scratch(self) -> int:
+        """Full recompute (test oracle for the incremental counter)."""
         n = sum(d.term_ids.nbytes + d.tfs.nbytes
                 for d in self._pending if d.live)
         for seg in self._segments:
@@ -341,6 +372,14 @@ class SegmentedIndex:
 
     def _make_view(self, seg: Segment, df_total: np.ndarray,
                    n_total: float) -> SegmentView:
+        # untouched segments reuse their cached view: rebuilding masks
+        # and re-uploading them for EVERY segment on EVERY commit was an
+        # O(corpus) host pass + device transfer on the streaming write
+        # path. Cosine views depend on the moving global df, so only the
+        # cosine model skips the cache.
+        if not self.model.needs_norms and seg.view_cache is not None \
+                and seg.view_cache[0] == seg.live_version:
+            return seg.view_cache[1]
         mask = np.zeros(seg.doc_cap, np.float32)
         mask[:seg.n_docs] = seg.live.astype(np.float32)
         if self.model.needs_norms:
@@ -361,10 +400,13 @@ class SegmentedIndex:
         if seg.res_tf is not None:
             res = (seg.res_tf, seg.res_term, seg.res_doc, seg.doc_len_d,
                    res_norms)
-        return SegmentView(
+        view = SegmentView(
             tfs=seg.tfs, terms=seg.terms, dls=seg.dls, norms=norms,
             block_live=seg.block_live, live_mask=jnp.asarray(mask),
             res=res)
+        if not self.model.needs_norms:
+            seg.view_cache = (seg.live_version, view)
+        return view
 
     def commit(self, vocab_cap: int) -> SegmentedSnapshot:
         with self._write_lock:
@@ -493,6 +535,10 @@ class SegmentedIndex:
                     self._where[d.name] = (merged, local)
                 else:
                     merged.live[local] = False
+                    # keep the every-tombstone-bumps-version invariant
+                    # (the merged segment has no cached view yet, but
+                    # the cache key must never go stale by construction)
+                    merged.live_version += 1
         global_metrics.inc("compactions")
 
     def _merge_inline_locked(self, sources: list[Segment],

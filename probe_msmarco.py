@@ -40,7 +40,10 @@ def main():
     from tfidf_tpu.utils.config import Config
 
     rng = np.random.default_rng(0)
-    engine = Engine(Config(index_mode="segments", query_batch=64))
+    # query_batch 16: at 8.8M docs the padded score space is ~11M
+    # columns; two pipelined [B, 11M] f32 buffers at B=64 tipped the
+    # 16GB HBM over by 240MB alongside the resident postings
+    engine = Engine(Config(index_mode="segments", query_batch=16))
     t0 = time.perf_counter()
     for i in range(NS_VOCAB):
         engine.vocab.add(f"t{i}")
@@ -79,8 +82,8 @@ def main():
                 and engine.index._merge_future is None:
             break
     quiesce_s = time.perf_counter() - q0
-    cm = np.asarray(commit_ms)
-    queries = make_queries(rng, NS_VOCAB, 64)
+    cm = np.asarray(commit_ms if commit_ms else [0.0])
+    queries = make_queries(rng, NS_VOCAB, 32)
     hits = engine.search_batch(queries, k=10)
     assert any(hits), "index must answer queries at full scale"
     out = {

@@ -88,6 +88,10 @@ class Snapshot:
     def is_ell(self) -> bool:
         return bool(self.ell_impacts) or self.tf is None
 
+    @property
+    def num_names(self) -> int:
+        return len(self.doc_names)
+
     def size_bytes(self) -> int:
         arrays = [self.tf, self.term, self.doc, self.doc_len, self.df,
                   self.res_tf, self.res_term, self.res_doc,

@@ -127,7 +127,7 @@ class Searcher(QueryVectorizerMixin):
         latency-bound and compute-bound throughput.
         """
         snap = self.index.snapshot
-        if snap is None or not snap.doc_names or not queries:
+        if snap is None or not snap.num_names or not queries:
             return [[] for _ in queries]
         k = self.top_k if k is None else k
         out: list[list[SearchHit]] = []
@@ -181,7 +181,7 @@ class Searcher(QueryVectorizerMixin):
         packed top-k still ON DEVICE (not fetched)."""
         scores = self._score_chunk(snap, queries)
         with trace_phase("topk"):
-            kk = min(k, len(snap.doc_names))
+            kk = min(k, snap.num_names)
             return packed_topk_chunked(scores, snap.num_docs, k=kk), kk
 
     def _finish_chunk(self, snap: Snapshot, queries: list[str],
@@ -199,7 +199,7 @@ class Searcher(QueryVectorizerMixin):
             # segmented doc ids interleave padding, so rank the whole
             # padded space (pads score 0 and are filtered below)
             rank_n = (scores.shape[-1] if segmented
-                      else len(snap.doc_names))
+                      else snap.num_names)
             vals, ids = full_ranking(scores, rank_n)
             vals = np.asarray(vals)
             ids = np.asarray(ids)

@@ -41,6 +41,7 @@ to names via each segment's name table.
 
 from __future__ import annotations
 
+import bisect
 import threading
 from dataclasses import dataclass, field
 
@@ -91,6 +92,29 @@ class Segment:
         return len(self.names)
 
 
+class _PaddedNameResolver:
+    """gid -> name over the concatenated padded segment spaces — the
+    ONE implementation of padded-id resolution (``name_of`` delegates
+    here too, so search-hit assembly and ``doc_name`` cannot drift)."""
+
+    __slots__ = ("_segments", "_bases")
+
+    def __init__(self, segments: list[Segment]) -> None:
+        self._segments = segments
+        bases = [0]
+        for seg in segments:
+            bases.append(bases[-1] + seg.doc_cap)
+        self._bases = bases
+
+    def __getitem__(self, gid: int):
+        i = bisect.bisect_right(self._bases, gid) - 1
+        if i < 0 or i >= len(self._segments):
+            return None
+        seg = self._segments[i]
+        local = gid - self._bases[i]
+        return seg.names[local] if local < seg.n_docs else None
+
+
 @dataclass
 class SegmentedSnapshot:
     """What queries score against: the committed segment list + stats.
@@ -114,6 +138,13 @@ class SegmentedSnapshot:
 
     # searcher compatibility surface
     @property
+    def num_names(self) -> int:
+        """Total name count, O(1) — building an 8.8M-entry list per
+        snapshot (i.e. after every streaming commit) just to len() it
+        was a measurable search-path cost."""
+        return sum(seg.n_docs for seg in self.segments)
+
+    @property
     def doc_names(self) -> list[str]:
         cached = getattr(self, "_doc_names", None)
         if cached is None:
@@ -124,15 +155,14 @@ class SegmentedSnapshot:
         return cached
 
     @property
-    def padded_names(self) -> list:
-        """Names aligned to the concatenated padded doc-id space (None at
-        pad slots); cached — segments are immutable once committed."""
+    def padded_names(self):
+        """Name lookup in the concatenated padded doc-id space (None at
+        pad slots). A lazy bisecting RESOLVER, not a materialized list:
+        top-k assembly touches a handful of ids per query, so building
+        the O(corpus) padded list per snapshot was pure waste."""
         cached = getattr(self, "_padded_names", None)
         if cached is None:
-            cached = []
-            for seg in self.segments:
-                cached.extend(seg.names)
-                cached.extend([None] * (seg.doc_cap - seg.n_docs))
+            cached = _PaddedNameResolver(self.segments)
             object.__setattr__(self, "_padded_names", cached)
         return cached
 
@@ -145,13 +175,9 @@ class SegmentedSnapshot:
         return bases
 
     def name_of(self, gid: int) -> str | None:
-        for base, seg in zip(self.bases, self.segments):
-            if base <= gid < base + seg.doc_cap:
-                local = gid - base
-                if local < seg.n_docs:
-                    return seg.names[local]
-                return None
-        return None
+        if gid < 0:
+            return None
+        return self.padded_names[gid]
 
 
 class SegmentedIndex:

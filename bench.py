@@ -485,8 +485,17 @@ def bench_mesh(rng) -> dict:
     log(f"[mesh] {MESH_DOCS} docs on {len(jax.devices())} device(s): "
         f"{qps:.0f} q/s, commit cold {commit_cold_s:.1f}s / steady "
         f"{commit_steady_s*1e3:.0f}ms")
+    # the DISTRIBUTED path gets its own oracle gate: the round-2 wire
+    # bug returned wrong doc ids exactly here, and the local-path check
+    # would not have seen it. The oracle corpus is the committed state
+    # (base + both appended delta batches).
+    n_all = MESH_DOCS + 200
+    parity = oracle_topk_parity(
+        engine, offsets[:n_all + 1], ids[:offsets[n_all]],
+        tfs[:offsets[n_all]], lengths[:n_all], queries[:64], NS_VOCAB)
     return {"qps": round(qps, 1), "commit_cold_s": round(commit_cold_s, 1),
             "commit_steady_ms": round(commit_steady_s * 1e3, 1),
+            "parity_checked": parity,
             "devices": len(jax.devices()), "n_docs": MESH_DOCS}
 
 

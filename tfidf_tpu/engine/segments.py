@@ -106,10 +106,15 @@ class _PaddedNameResolver:
             bases.append(bases[-1] + seg.doc_cap)
         self._bases = bases
 
+    def __len__(self) -> int:
+        return self._bases[-1]
+
     def __getitem__(self, gid: int):
+        # IndexError past the padded space keeps the sequence protocol
+        # intact (iteration must terminate); in-range pad slots are None
+        if gid < 0 or gid >= self._bases[-1]:
+            raise IndexError(gid)
         i = bisect.bisect_right(self._bases, gid) - 1
-        if i < 0 or i >= len(self._segments):
-            return None
         seg = self._segments[i]
         local = gid - self._bases[i]
         return seg.names[local] if local < seg.n_docs else None
@@ -175,9 +180,10 @@ class SegmentedSnapshot:
         return bases
 
     def name_of(self, gid: int) -> str | None:
-        if gid < 0:
+        try:
+            return self.padded_names[gid]
+        except IndexError:
             return None
-        return self.padded_names[gid]
 
 
 class SegmentedIndex:

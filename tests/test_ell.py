@@ -101,6 +101,19 @@ class TestBuild:
         assert ell.res_nnz > 0
         assert (np.diff(ell.res_doc) >= 0).all()
 
+    def test_non_ladder_width_cap_conserves_entries(self, rng):
+        """width_cap values that are not ladder rungs (e.g. 100, 512)
+        must still conserve every posting between blocks and residual —
+        a regression guard for the ladder/spill boundary mismatch."""
+        docs = [{t: 1 for t in range(n)} for n in (300, 120, 90, 40, 3)]
+        total = sum(len(d) for d in docs)
+        for cap in (100, 512, 20):
+            coo = build_coo(docs, vocab_cap=512, min_nnz_cap=1 << 11,
+                            min_doc_cap=16)
+            ell = build_ell_from_coo(coo, width_cap=cap, min_rows=8)
+            main = sum(int((b.tf > 0).sum()) for b in ell.blocks)
+            assert main + ell.res_nnz == total, cap
+
     def test_unsorted_rows_rejected(self, rng):
         docs = [{1: 1}, {1: 1, 2: 1, 3: 1}]    # ascending length
         coo = build_coo(docs, vocab_cap=8, min_nnz_cap=64, min_doc_cap=8)

@@ -47,8 +47,11 @@ from tfidf_tpu.ops.scoring import (QueryBatch, _compile_queries,
                                    tfidf_weights)
 from tfidf_tpu.ops.topk import exact_topk, merge_topk, pack_topk
 
-# fixed width buckets so every shard shares one block structure
-ELL_WIDTHS = (256, 128, 64, 32, 16, 8)
+# fixed width buckets so every shard shares one block structure; every
+# width is a multiple of 8 so the terms axis (up to 8-way) can shard the
+# width columns evenly. The 1.5x intermediate steps cut pad entries
+# ~13% vs pure powers of two (see ops/ell.py ELL_WIDTH_LADDER).
+ELL_WIDTHS = (256, 192, 128, 96, 64, 48, 32, 24, 16, 8)
 
 
 @dataclass
@@ -122,8 +125,11 @@ def build_mesh_ell(entries_per_shard: list[list],   # list[DocEntry]/shard
             k = e.term_ids.shape[0]
             b = _bucket_of(k, widths)
             rows_need[s, b] += 1
-            if k > width_cap:
-                res_need[s] += k - width_cap
+            if k > widths[b]:
+                # spill size must use the BUCKET width (the widest rung
+                # <= width_cap), not width_cap itself — for non-rung
+                # caps the estimate would undercount the residual
+                res_need[s] += k - widths[b]
     doc_cap = next_capacity(max(max(doc_caps, default=1), 1), min_rows)
     rows_cap = [next_capacity(int(rows_need[:, b].max()) or 1, min_rows)
                 for b in range(len(widths))]

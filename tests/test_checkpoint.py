@@ -73,3 +73,55 @@ def test_repeated_saves_prune_versions(tmp_path):
     assert len(versions) == 1          # superseded versions pruned
     e2 = load_checkpoint(ckpt, e.config)
     assert e2.index.num_live_docs == len(CORPUS) + 3
+
+
+def test_bulk_restore_equals_per_doc_replay(tmp_path):
+    """VERDICT r3 #5: the packed bulk-load restore (no per-doc Python
+    loop, vectorized COO commit) must be result-identical to the per-doc
+    array replay, including tie order, and leave the index fully mutable
+    (upsert, delete) afterwards."""
+    import numpy as np
+
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    for i in range(30):   # enough docs for several ELL width buckets
+        e.ingest_text(f"extra{i}.txt",
+                      " ".join(f"w{j}" for j in range(i % 7 + 1))
+                      + " fast shared")
+    e.commit()
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+
+    e_bulk = load_checkpoint(ckpt, e.config)
+    assert e_bulk.index._packed is not None   # fast path actually taken
+
+    # forced per-doc replay for comparison
+    e_slow = load_checkpoint(ckpt, e.config)
+    e_slow.index._packed = None
+    e_slow.index._docs, e_slow.index._by_name = [], {}
+    import json, os
+    data = np.load(os.path.join(ckpt, "docs.npz"))
+    with open(os.path.join(ckpt, "names.json"), encoding="utf-8") as f:
+        names = json.load(f)
+    offs = data["offsets"]
+    for i, name in enumerate(names):
+        lo, hi = int(offs[i]), int(offs[i + 1])
+        e_slow.index.add_document_arrays(
+            name, data["term_ids"][lo:hi], data["tfs"][lo:hi],
+            float(data["lengths"][i]))
+    e_slow.commit()
+
+    for q in ("fast food", "shared", "w3 w4", "cat night"):
+        b = [(h.name, round(h.score, 5)) for h in e_bulk.search(q, k=20)]
+        s = [(h.name, round(h.score, 5)) for h in e_slow.search(q, k=20)]
+        assert b == s, (q, b, s)
+
+    # post-restore mutations drop the packed fast path, not correctness
+    e_bulk.ingest_text("file1.txt", "totally different now")   # upsert
+    assert e_bulk.delete("extra0.txt")
+    e_bulk.commit()
+    assert e_bulk.index._packed is None
+    names_after = [h.name for h in e_bulk.search("fast", k=50)]
+    assert "file1.txt" not in names_after      # re-written content
+    assert "extra0.txt" not in names_after     # deleted
+    assert "extra1.txt" in names_after

@@ -255,6 +255,30 @@ class TestClusterEndToEnd:
                  for w in json.loads(http_get(leader.url + "/api/services"))]
         assert all(s > 0 for s in sizes)
 
+    def test_concurrent_same_name_uploads_place_once(self, cluster):
+        """ADVICE r3 #1: concurrent uploads of the same NEW name must all
+        route to ONE worker (tentative claim under the placement lock) —
+        without it two handlers both miss the map and place twin copies
+        that double-count in the scatter-gather sum-merge."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        leader = cluster[0]
+
+        def up(i):
+            return http_post(
+                leader.url + "/leader/upload?name=same.txt",
+                f"unique pelican document copy {i}".encode(),
+                content_type="application/octet-stream").decode()
+
+        with ThreadPoolExecutor(8) as ex:
+            res = list(ex.map(up, range(16)))
+        assert all("uploaded successfully" in r for r in res)
+        assert len({r.rsplit(": ", 1)[-1] for r in res}) == 1
+        result = json.loads(http_post(
+            leader.url + "/leader/start",
+            json.dumps({"query": "pelican"}).encode()))
+        assert list(result) == ["same.txt"]
+
     def test_bulk_upload_batch_and_nrt_visibility(self, cluster):
         """Framework addition: /leader/upload-batch places a whole batch
         with one request per worker; deferred (NRT) commits are flushed

@@ -208,3 +208,31 @@ def test_commit_not_lost_on_interleaved_write(tmp_path):
     # the raced write is NOT silently lost: next commit includes it
     e.index.commit(e.vocab.capacity())
     assert "raced.txt" in e.index.snapshot.doc_names
+
+
+def test_concurrent_ingest_keeps_vocab_consistent(tmp_path):
+    """Concurrent HTTP upload handlers reach ingest_text directly; the
+    engine write lock (the reference's synchronized(indexWriter),
+    Worker.java:136-139) must keep Vocabulary.add's read-len-then-append
+    atomic — without it two new terms can share one id and queries score
+    the wrong column."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    e = make_engine(tmp_path)
+    n_threads, docs_per = 8, 25
+
+    def ingest(t):
+        for i in range(docs_per):
+            terms = " ".join(f"term{t}x{i}y{j}" for j in range(6))
+            e.ingest_text(f"doc_{t}_{i}.txt", terms)
+
+    with ThreadPoolExecutor(n_threads) as ex:
+        list(ex.map(ingest, range(n_threads)))
+    terms = e.vocab.all_terms()
+    assert len(terms) == n_threads * docs_per * 6
+    # bijective: every term resolves to a unique id and back
+    ids = {e.vocab.lookup(t) for t in terms}
+    assert len(ids) == len(terms)
+    e.commit()
+    hits = e.search("term3x7y2")
+    assert [h.name for h in hits] == ["doc_3_7.txt"]

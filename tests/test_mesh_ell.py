@@ -105,6 +105,24 @@ class TestEquivalence:
         assert abs(s1["a.txt"] - s2["a.txt"]) > 1e-6
 
 
+class TestUnboundedGuard:
+    def test_parity_fallback_refuses_past_cap(self, tmp_path):
+        """VERDICT r3 #7: the unbounded parity fallback is an O(corpus)
+        duplicate-index replay; past the size cap it must fail fast with
+        a clear error instead of stalling the node, and raising the cap
+        explicitly must re-enable it."""
+        e = make_engine(tmp_path, "ug", "mesh")
+        for name, text in TEXTS.items():
+            e.ingest_text(name, text)
+        e.commit()
+        e.searcher.unbounded_parity_max_docs = 5   # below the 10 live docs
+        with pytest.raises(ValueError, match="parity fallback refused"):
+            e.search("fox", unbounded=True)
+        e.searcher.unbounded_parity_max_docs = 1_000   # explicit opt-in
+        hits = e.search("fox", unbounded=True)
+        assert hits
+
+
 class TestLifecycle:
     def test_delete_in_base_and_delta(self, tmp_path):
         e = make_engine(tmp_path, "del", "mesh")

@@ -128,6 +128,14 @@ class SearchNode:
         # holder, keeping one copy per name; see leader_upload)
         self._size_cache: tuple[float, dict[str, int]] = (0.0, {})
         self._placement: dict[str, str] = {}
+        self._claims: dict[str, object] = {}   # in-flight claim tokens
+        self._inflight: dict[str, int] = {}    # uploads in flight per name
+        # guards _placement + _size_cache against concurrent
+        # ThreadingHTTPServer upload handlers: without it two
+        # simultaneous uploads of the same NEW name can both miss the
+        # placement map and place duplicate copies on different
+        # workers — exactly the double-count the map exists to prevent
+        self._placement_lock = threading.Lock()
 
         handler = type("Handler", (_NodeHandler,), {"node": self})
         self.httpd = ThreadingHTTPServer(
@@ -189,7 +197,13 @@ class SearchNode:
             with self._commit_lock:
                 if self._dirty:
                     self._dirty = False
-                    self.engine.commit()
+                    try:
+                        self.engine.commit()
+                    except BaseException:
+                        # a failed commit must not leave the node serving
+                        # stale pre-upload results forever
+                        self._dirty = True
+                        raise
 
     # ---- session-expiry recovery ----
 
@@ -286,26 +300,107 @@ class SearchNode:
     # its local estimates by the bytes it placed, so bursts still spread
     _SIZE_POLL_TTL_S = 1.0
 
-    def _polled_sizes(self, workers: list[str]) -> dict[str, int]:
-        """Worker index sizes with a TTL cache over the per-upload
-        polling loop of ``Leader.java:170-179``. Raises when no worker
-        answers. The returned dict is the live cache: callers bump the
-        chosen worker's estimate after a successful placement."""
+    def _ensure_sizes_fresh(self, workers: list[str]) -> None:
+        """Refresh the worker index-size TTL cache (the per-upload
+        polling loop of ``Leader.java:170-179``). Raises when no worker
+        answers. The serial HTTP polls run OUTSIDE ``_placement_lock`` —
+        one slow/unreachable worker must not stall every concurrent
+        upload handler for the poll timeout; only the freshness check
+        and the install are under the lock."""
         now = time.monotonic()
-        ts, sizes = self._size_cache
-        if now - ts > self._SIZE_POLL_TTL_S or set(sizes) != set(workers):
-            sizes = {}
-            for w in workers:   # serial polling, like Leader.java:170-179
-                try:
-                    global_injector.check("leader.size_poll")
-                    sizes[w] = int(http_get(w + "/worker/index-size"))
-                except Exception as e:
-                    log.warning("index-size poll failed", worker=w,
-                                err=repr(e))
-            if not sizes:
-                raise RuntimeError("no reachable workers")
-            self._size_cache = (now, sizes)
-        return sizes
+        with self._placement_lock:
+            ts, sizes = self._size_cache
+            if (now - ts <= self._SIZE_POLL_TTL_S
+                    and set(sizes) == set(workers)):
+                return
+        polled = {}
+        for w in workers:   # serial polling, like Leader.java:170-179
+            try:
+                global_injector.check("leader.size_poll")
+                polled[w] = int(http_get(w + "/worker/index-size"))
+            except Exception as e:
+                log.warning("index-size poll failed", worker=w,
+                            err=repr(e))
+        if not polled:
+            raise RuntimeError("no reachable workers")
+        with self._placement_lock:
+            ts2, cur = self._size_cache
+            if ts2 <= ts:   # no fresher concurrent poll landed meanwhile
+                self._size_cache = (now, polled)
+            else:
+                # a concurrent poll won the install; MERGE our results in
+                # for workers it did not cover (its registry view may
+                # differ from ours) so this caller's worker set is still
+                # represented — discarding our poll could leave the
+                # cache empty for our workers and 500 a healthy upload
+                self._size_cache = (ts2, {**polled, **cur})
+
+    def _route_name(self, name: str, workers: list[str],
+                    sizes: dict[str, int]):
+        """Route one document name to a worker. Caller holds
+        ``_placement_lock``. A held name goes to its holder — membership
+        is judged against the REGISTRY list, not poll success, so one
+        transient size-poll failure cannot re-place an already-placed
+        name on a second worker. New names go least-loaded among workers
+        present in ``sizes`` and are tentatively claimed; returns
+        ``(worker, claim_token | None)``."""
+        held = self._placement.get(name)
+        if held in workers:
+            return held, None
+        live = {w: sizes[w] for w in workers if w in sizes}
+        if not live:
+            raise RuntimeError("no reachable workers")
+        chosen = min(live, key=lambda w: (live[w], w))
+        self._placement[name] = chosen
+        token = object()
+        self._claims[name] = token
+        return chosen, token
+
+    def _track_inflight(self, name: str) -> None:
+        """Count an upload of ``name`` as in flight (caller holds
+        ``_placement_lock``); settled by ``_settle_success`` /
+        ``_settle_failure``."""
+        self._inflight[name] = self._inflight.get(name, 0) + 1
+
+    def _dec_inflight(self, name: str) -> int:
+        n = self._inflight.get(name, 1) - 1
+        if n > 0:
+            self._inflight[name] = n
+        else:
+            self._inflight.pop(name, None)
+        return n
+
+    def _settle_success(self, name: str, worker: str,
+                        nbytes: int) -> None:
+        """Record a worker-ACCEPTED placement. Caller holds
+        ``_placement_lock``. Clears ANY pending claim for the name —
+        the placement is confirmed now, so a failed sibling upload must
+        not release it."""
+        self._dec_inflight(name)
+        self._claims.pop(name, None)
+        self._placement[name] = worker
+        sizes = self._size_cache[1]
+        sizes[worker] = sizes.get(worker, 0) + nbytes
+
+    def _settle_failure(self, name: str, token, worker: str) -> None:
+        """Undo a tentative claim after a failed forward. Caller holds
+        ``_placement_lock``. Two guards prevent deleting state that is
+        not ours to delete:
+
+        * identity-compare the claim token — a worker-identity compare
+          would let a failed upload delete a CONCURRENT upload's
+          confirmed placement of the same name (held routing guarantees
+          both chose the same worker);
+        * drop the tentative placement only when NO sibling upload of
+          the name is still in flight — an in-flight sibling may yet
+          succeed at this worker, and deleting the entry under it would
+          let a third upload re-place the name on a different worker
+          (duplicate copies, double-counted in the sum-merge)."""
+        remaining = self._dec_inflight(name)
+        if token is not None and self._claims.get(name) is token:
+            del self._claims[name]
+            if remaining <= 0 and self._placement.get(name) == worker:
+                del self._placement[name]
 
     def leader_upload(self, filename: str, data: bytes) -> dict:
         """Least-loaded placement (``Leader.java:153-207``) with two
@@ -326,23 +421,47 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
-        held = self._placement.get(filename)
-        if held in workers:
-            chosen = held
-            sizes = self._size_cache[1]
-        else:
-            sizes = self._polled_sizes(workers)
-            chosen = min(sizes, key=lambda w: (sizes[w], w))
+        with self._placement_lock:
+            held = self._placement.get(filename)
+            if held in workers:
+                chosen = held
+                self._track_inflight(filename)
+            else:
+                chosen = None
+        token = None
+        if chosen is None:
+            self._ensure_sizes_fresh(workers)   # polls outside the lock
+            with self._placement_lock:
+                chosen, token = self._route_name(
+                    filename, workers, self._size_cache[1])
+                self._track_inflight(filename)
         q = urllib.parse.quote(filename)
-        http_post(chosen + f"/worker/upload?name={q}", data,
-                  content_type="application/octet-stream")
-        # placement/size state is updated only AFTER the worker accepted
-        sizes[chosen] = sizes.get(chosen, 0) + len(data)
-        self._placement[filename] = chosen
+        try:
+            http_post(chosen + f"/worker/upload?name={q}", data,
+                      content_type="application/octet-stream")
+        except BaseException as e:
+            # a 4xx is an APPLICATION rejection (e.g. 415 on binary
+            # formats) from a healthy worker — don't evict it from the
+            # size cache, or interleaved bad uploads force a full
+            # serial re-poll before every good one
+            app_reject = (isinstance(e, urllib.error.HTTPError)
+                          and e.code < 500)
+            with self._placement_lock:
+                self._settle_failure(filename, token, chosen)
+                # evict the unreachable worker from the size cache: the
+                # set-mismatch forces the next upload to re-poll at once
+                # instead of re-choosing the dead worker until TTL expiry
+                if not app_reject:
+                    self._size_cache[1].pop(chosen, None)
+            raise
+        # size/placement state is confirmed only AFTER the worker accepted
+        with self._placement_lock:
+            self._settle_success(filename, chosen, len(data))
+            sizes = dict(self._size_cache[1])
         global_metrics.inc("uploads_placed")
         log.info("upload placed", file=filename, worker=chosen,
                  size=sizes[chosen])
-        return {"worker": chosen, "sizes": dict(sizes)}
+        return {"worker": chosen, "sizes": sizes}
 
     def leader_upload_batch(self, docs: list[dict]) -> dict:
         """Bulk ingest (framework addition — the reference only places
@@ -353,20 +472,34 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
-        sizes = self._polled_sizes(workers)
-        # plan the split with a local estimate; the shared cache and the
-        # placement map are updated only for groups a worker ACCEPTED —
-        # a failed forward must not leave the leader believing the
-        # unreachable worker holds documents it never received
-        est = dict(sizes)
+        # plan the split with a local estimate; size-cache confirmations
+        # happen only for groups a worker ACCEPTED — a failed forward
+        # must not leave the leader believing the unreachable worker
+        # holds documents it never received. New names are tentatively
+        # claimed (token-identified) under the lock so a concurrent
+        # upload of the same name routes to the same worker.
+        self._ensure_sizes_fresh(workers)   # polls outside the lock
         per_worker: dict[str, list[dict]] = {}
-        for d in docs:
-            name = d["name"]
-            held = self._placement.get(name)
-            w = held if held in est else min(
-                est, key=lambda x: (est[x], x))
-            per_worker.setdefault(w, []).append(d)
-            est[w] = est.get(w, 0) + len(d.get("text", ""))
+        claimed: dict[str, dict[str, object]] = {}   # w -> {name: token}
+        with self._placement_lock:
+            # plan against a local estimate so the batch itself spreads
+            # by projected size; claims/placements go through the same
+            # routing rule as the per-file path
+            est = {w: self._size_cache[1][w] for w in workers
+                   if w in self._size_cache[1]}
+            for d in docs:
+                name = d["name"]
+                w, token = self._route_name(name, workers, est)
+                if token is not None:
+                    claimed.setdefault(w, {})[name] = token
+                self._track_inflight(name)
+                per_worker.setdefault(w, []).append(d)
+                # bump only workers already in the estimate: a held name
+                # routed to an unpolled worker must not inject it at
+                # near-zero size, or every later NEW name in the batch
+                # would min-route onto the possibly-unreachable worker
+                if w in est:
+                    est[w] += len(d.get("text", ""))
         placed = {}
         errors = {}
         skipped: list[dict] = []
@@ -377,6 +510,15 @@ class SearchNode:
                     json.dumps(group).encode(), timeout=300.0))
             except Exception as e:
                 errors[w] = repr(e)
+                app_reject = (isinstance(e, urllib.error.HTTPError)
+                              and e.code < 500)
+                with self._placement_lock:
+                    w_claims = claimed.get(w, {})
+                    for d in group:   # settle EVERY name, claimed or held
+                        self._settle_failure(
+                            d["name"], w_claims.get(d["name"]), w)
+                    if not app_reject:      # fast re-poll on transport
+                        self._size_cache[1].pop(w, None)   # failures only
                 continue
             # the worker reports per-doc UnsupportedMediaType skips —
             # those names were NOT indexed and must not enter the
@@ -384,11 +526,15 @@ class SearchNode:
             w_skipped = {s["name"] for s in resp.get("skipped", ())}
             skipped.extend(resp.get("skipped", ()))
             placed[w] = len(group) - len(w_skipped)
-            for d in group:
-                if d["name"] in w_skipped:
-                    continue
-                self._placement[d["name"]] = w
-                sizes[w] = sizes.get(w, 0) + len(d.get("text", ""))
+            with self._placement_lock:
+                for d in group:
+                    name = d["name"]
+                    if name in w_skipped:
+                        self._settle_failure(
+                            name, claimed.get(w, {}).get(name), w)
+                        continue
+                    self._settle_success(name, w,
+                                         len(d.get("text", "")))
             global_metrics.inc("uploads_placed", placed[w])
         if errors and not placed:
             raise RuntimeError(f"all workers failed: {errors}")

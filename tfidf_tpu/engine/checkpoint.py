@@ -116,14 +116,23 @@ def load_checkpoint(directory: str, config: Config | None = None) -> Engine:
     term_ids = data["term_ids"]
     tfs = data["tfs"]
     lengths = data["lengths"]
-    # bulk restore: feed the packed arrays straight through the
-    # array-ingest path — docs.npz already stores exactly what
-    # add_document_arrays wants; replaying through per-doc dict
-    # construction cost minutes at 1M docs (VERDICT r2 #8a)
-    add = engine.index.add_document_arrays
-    for i, name in enumerate(names):
-        lo, hi = int(offsets[i]), int(offsets[i + 1])
-        add(name, term_ids[lo:hi], tfs[lo:hi], float(lengths[i]))
+    # bulk restore: docs.npz already stores exactly the packed arrays
+    # the index wants. Indexes with a packed loader (ShardIndex) take
+    # them whole — no per-document Python loop, and the following
+    # commit builds its COO vectorized from the same arrays
+    # (VERDICT r2 #8a, r3 #5); other index kinds replay per-doc views
+    # through the array-ingest path.
+    if hasattr(engine.index, "bulk_load_packed"):
+        engine.index.bulk_load_packed(names, offsets, term_ids, tfs,
+                                      lengths)
+    else:
+        add = engine.index.add_document_arrays
+        lo_list = offsets[:-1].tolist()
+        hi_list = offsets[1:].tolist()
+        len_list = lengths.tolist()
+        for i, name in enumerate(names):
+            add(name, term_ids[lo_list[i]:hi_list[i]],
+                tfs[lo_list[i]:hi_list[i]], len_list[i])
     engine.commit()
     log.info("checkpoint loaded", dir=directory, docs=len(names))
     return engine

@@ -14,6 +14,7 @@ over that rebuild, not a requirement for correctness.
 from __future__ import annotations
 
 import os
+import threading
 
 from tfidf_tpu.engine.index import ShardIndex
 from tfidf_tpu.engine.segments import SegmentedIndex
@@ -36,6 +37,10 @@ class Engine:
         the "docs" axis (``Config.mesh_shape`` overrides)."""
         self.config = config or Config()
         c = self.config
+        # single-writer mutation guard (the reference's
+        # ``synchronized(indexWriter)``, Worker.java:136-139); RLock
+        # because ingest_bytes -> ingest_text nests
+        self._write_lock = threading.RLock()
         self.analyzer = Analyzer(
             lowercase=c.lowercase,
             stopwords=frozenset(c.stopwords),
@@ -128,7 +133,11 @@ class Engine:
     # ---- ingest (Worker.upload / addDocToIndex analog) ----
 
     def ingest_text(self, name: str, text: str) -> None:
-        with trace_phase("analyze"):
+        # the write lock is the reference's ``synchronized(indexWriter)``
+        # (Worker.java:136-139): concurrent HTTP upload handlers reach
+        # this path, and neither Vocabulary.add (read-len-then-append)
+        # nor the index mutation below is safe under interleaving
+        with self._write_lock, trace_phase("analyze"):
             if self.native is not None:
                 res = self.native.analyze(text, add=True)
                 if res is not None:
@@ -138,27 +147,46 @@ class Engine:
             counts = self.analyzer.counts(text)
             length = float(sum(counts.values()))
             id_counts = self.vocab.map_counts(counts, add=True)
-        self.index.add_document(name, id_counts, length=length)
+            self.index.add_document(name, id_counts, length=length)
 
     def ingest_bytes(self, name: str, data: bytes,
                      save_to_disk: bool = False) -> None:
         """Full upload path: optional durable write of the raw document
         (the reference's ``Files.copy`` to ``${mydocument.path}``,
-        ``Worker.java:133-134``), then extract + index."""
-        if save_to_disk:
-            path = self._safe_doc_path(name)
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = path + ".part"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
-        self.ingest_text(name, extract_text(data))
+        ``Worker.java:133-134``), then extract + index.
+
+        The write lock spans BOTH the disk write and the indexing so
+        concurrent same-name uploads leave disk and index agreeing on
+        one writer's content — otherwise a restart's
+        ``build_from_directory`` re-walk could silently flip search
+        results to the other writer's version."""
+        # extract before taking the lock: an UnsupportedMediaType must
+        # refuse without leaving bytes on disk, and extraction needs no
+        # shared state
+        text = extract_text(data)
+        with self._write_lock:
+            if save_to_disk:
+                path = self._safe_doc_path(name)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                # unique temp per writer: concurrent uploads of the SAME
+                # name sharing one ".part" path race — the loser's
+                # os.replace dies after the winner moved it away
+                tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.part"
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(data)
+                    os.replace(tmp, path)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+            self.ingest_text(name, text)
 
     def delete(self, name: str) -> bool:
-        return self.index.delete_document(name)
+        with self._write_lock:
+            return self.index.delete_document(name)
 
     def commit(self) -> None:
-        with trace_phase("commit"), Stopwatch() as sw:
+        with self._write_lock, trace_phase("commit"), Stopwatch() as sw:
             self.index.commit(self.vocab.capacity())
         log.info("commit", ms=sw.ms, docs=self.index.num_live_docs)
 

@@ -73,10 +73,12 @@ def pack_topk(vals: jax.Array, ids: jax.Array) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("k",))
 def packed_topk(scores: jax.Array, num_docs: jax.Array,
                 *, k: int) -> jax.Array:
-    """Top-k with values and indices packed into ONE f32 array
-    ``[B, 2k]`` — a single device-to-host transfer fetches both. Matters
-    when the host↔device link has high per-transfer latency (remote-TPU
-    tunnels); unpack with :func:`unpack_topk`."""
+    """Top-k with values and indices packed into ONE i32 array
+    ``[B, 2k]`` (float bits bitcast into the integer lanes — see
+    :func:`pack_topk` for why the wire dtype must be integer) — a single
+    device-to-host transfer fetches both. Matters when the host↔device
+    link has high per-transfer latency (remote-TPU tunnels); unpack with
+    :func:`unpack_topk`."""
     vals, idx = exact_topk(scores, num_docs, k=k)
     return pack_topk(vals, idx)
 
@@ -105,9 +107,7 @@ def packed_topk_chunked(scores: jax.Array, num_docs: jax.Array,
     """
     B, doc_cap = scores.shape
     c = min(chunk, doc_cap)
-    while doc_cap % c:          # power-of-two caps make this a no-op
-        c -= 1
-    n = doc_cap // c
+    n = -(-doc_cap // c)        # ceil: the tail chunk is clamped, not ragged
     if n == 1:
         return packed_topk(scores, num_docs, k=k)
 
@@ -115,12 +115,17 @@ def packed_topk_chunked(scores: jax.Array, num_docs: jax.Array,
         # dynamic_slice, NOT a [B, n, c] reshape+transpose: that would
         # materialize a second doc_cap-sized copy of the scores, which
         # at 1M docs and wide batches is the difference between fitting
-        # HBM and not
-        x = jax.lax.dynamic_slice_in_dim(scores, off, c, axis=1)
-        idx = jnp.arange(c, dtype=jnp.int32)[None, :] + off
-        masked = jnp.where(idx < num_docs, x, -jnp.inf)
+        # HBM and not.
+        # The last chunk's start is clamped to doc_cap - c so every slice
+        # is full-width regardless of doc_cap % c; columns the clamp makes
+        # overlap the previous chunk (idx < off) are masked out so no doc
+        # can win twice in the merge.
+        start = jnp.minimum(off, doc_cap - c)
+        x = jax.lax.dynamic_slice_in_dim(scores, start, c, axis=1)
+        idx = jnp.arange(c, dtype=jnp.int32)[None, :] + start
+        masked = jnp.where((idx >= off) & (idx < num_docs), x, -jnp.inf)
         v, i = jax.lax.top_k(masked, k)
-        return None, (v, i.astype(jnp.int32) + off)
+        return None, (v, i.astype(jnp.int32) + start)
 
     offs = jnp.arange(n, dtype=jnp.int32) * c
     _, (vals, ids) = jax.lax.scan(body, None, offs)    # [n, B, k]

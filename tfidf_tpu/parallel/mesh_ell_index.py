@@ -450,6 +450,16 @@ class MeshEllIndex(MeshIndex):
 class MeshEllSearcher(MeshSearcher):
     """MeshSearcher over the ELL base + delta snapshot."""
 
+    # Hard cap on corpus size for the unbounded parity fallback: the
+    # fallback rebuilds a full duplicate COO MeshIndex (host loop over
+    # every live doc + a device commit) and roughly doubles HBM
+    # residency while cached. That is fine as a correctness tool at
+    # test scale, but a stray ``unbounded=True`` against a large
+    # serving engine must fail fast instead of stalling the node for
+    # minutes. Raise the attribute explicitly on a searcher instance to
+    # opt in to a bigger parity replay.
+    unbounded_parity_max_docs: int = 200_000
+
     def _get_search_fn(self, k: int):
         fn = self._search_fns.get(k)
         if fn is None:
@@ -498,6 +508,15 @@ class MeshEllSearcher(MeshSearcher):
         cached = getattr(self, "_unbounded_cache", None)
         if cached is not None and cached[0] == snap.version:
             return cached[1].search(queries, k=k, unbounded=True)
+        total_live = int(np.sum(np.asarray(snap.n_docs)))
+        if total_live > self.unbounded_parity_max_docs:
+            raise ValueError(
+                f"unbounded=True parity fallback refused: snapshot holds "
+                f"{total_live} live docs > cap "
+                f"{self.unbounded_parity_max_docs}. The fallback rebuilds "
+                f"a duplicate COO index (O(corpus) host replay + ~2x HBM); "
+                f"it is a parity/testing tool, not a serving path. Set "
+                f"searcher.unbounded_parity_max_docs explicitly to opt in.")
         base_live = np.asarray(snap.base.live)       # [D, doc_cap_ell]
         delta_live = np.asarray(snap.delta.live)     # [D, doc_cap_delta]
         delta_n = np.asarray(snap.delta.n_live)      # [D]

@@ -500,6 +500,76 @@ def bench_mesh(rng) -> dict:
 
 
 # --------------------------------------------------------------------------
+# shared cluster-bench plumbing (configs 2 and 2b)
+# --------------------------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http_get(url: str, timeout: float = 10.0) -> bytes:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _wait_until(pred, timeout: float = 240.0) -> None:
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return
+        except Exception as e:
+            last = e
+        time.sleep(0.3)
+    raise AssertionError(f"timeout; last={last!r}")
+
+
+class _KeepAlive:
+    """One persistent HTTP connection per (thread, port); one retry on a
+    dropped keep-alive connection."""
+
+    def __init__(self) -> None:
+        import threading
+        self._tls = threading.local()
+
+    def post(self, hostport: tuple[str, int], path: str, data: bytes,
+             timeout: float = 600.0) -> bytes:
+        import http.client
+        key = f"conn_{hostport[1]}"
+        for _ in range(2):
+            c = getattr(self._tls, key, None)
+            if c is None:
+                c = http.client.HTTPConnection(*hostport, timeout=timeout)
+                setattr(self._tls, key, c)
+            try:
+                c.request("POST", path, body=data, headers={
+                    "Content-Type": "application/octet-stream"})
+                return c.getresponse().read()
+            except Exception:
+                c.close()
+                setattr(self._tls, key, None)
+        raise RuntimeError("post failed")
+
+
+def _kill_all(procs) -> None:
+    for p in procs:
+        try:
+            p.kill()
+        except Exception:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
 # config 2: 2-worker cluster, real HTTP scatter-gather (VERDICT r2 #3a)
 # --------------------------------------------------------------------------
 
@@ -523,16 +593,6 @@ def bench_cluster(rng) -> dict:
     import socket
     import subprocess
     import tempfile
-    import urllib.request
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    def get(url, timeout=10.0):
-        with urllib.request.urlopen(url, timeout=timeout) as r:
-            return r.read()
 
     t0 = time.perf_counter()
     texts = make_texts(rng, C2_DOCS, C2_VOCAB, C2_AVG_LEN)
@@ -551,59 +611,24 @@ def bench_cluster(rng) -> dict:
         procs.append(p)
         return p
 
-    def wait(pred, timeout=120.0):
-        deadline = time.monotonic() + timeout
-        last = None
-        while time.monotonic() < deadline:
-            try:
-                if pred():
-                    return
-            except Exception as e:
-                last = e
-            time.sleep(0.3)
-        raise AssertionError(f"timeout; last={last!r}")
-
+    client = _KeepAlive()
     try:
-        coord = free_port()
+        coord = _free_port()
         spawn(["coordinator", "--listen", f"127.0.0.1:{coord}"])
-        wait(lambda: socket.create_connection(
+        _wait_until(lambda: socket.create_connection(
             ("127.0.0.1", coord), timeout=1).close() or True)
-        ports = [free_port() for _ in range(3)]
+        ports = [_free_port() for _ in range(3)]
         urls = [f"http://127.0.0.1:{p}" for p in ports]
         for i, port in enumerate(ports):
             spawn(["serve", "--port", str(port), "--host", "127.0.0.1",
                    "--coordinator-address", f"127.0.0.1:{coord}",
                    "--documents-path", f"{tmp}/n{i}/docs",
                    "--index-path", f"{tmp}/n{i}/index"])
-            wait(lambda u=urls[i]: get(u + "/api/status"))
+            _wait_until(lambda u=urls[i]: _http_get(u + "/api/status"))
         leader = urls[0]
-        wait(lambda: len(_json.loads(get(leader + "/api/services"))) == 2)
-
-        import http.client
-        import threading as _threading
-        tls = _threading.local()
-        leader_hostport = ("127.0.0.1", ports[0])
-
-        def conn():
-            c = getattr(tls, "conn", None)
-            if c is None:
-                c = http.client.HTTPConnection(*leader_hostport,
-                                               timeout=120.0)
-                tls.conn = c
-            return c
-
-        def post_keepalive(path, data):
-            for _ in range(2):          # one retry on a dropped conn
-                c = conn()
-                try:
-                    c.request("POST", path, body=data, headers={
-                        "Content-Type": "application/octet-stream"})
-                    r = c.getresponse()
-                    return r.read()
-                except Exception:
-                    c.close()
-                    tls.conn = None
-            raise RuntimeError("post failed")
+        leader_hp = ("127.0.0.1", ports[0])
+        _wait_until(lambda: len(_json.loads(
+            _http_get(leader + "/api/services"))) == 2)
 
         groups = [[{"name": f"d{i}.txt", "text": texts[i]}
                    for i in range(lo, min(lo + 500, C2_DOCS))]
@@ -611,15 +636,15 @@ def bench_cluster(rng) -> dict:
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(C2_CLIENTS) as ex:
             list(ex.map(
-                lambda g: post_keepalive("/leader/upload-batch",
-                                         _json.dumps(g).encode()),
+                lambda g: client.post(leader_hp, "/leader/upload-batch",
+                                      _json.dumps(g).encode()),
                 groups))
         upload_s = time.perf_counter() - t0
         log(f"[c2] uploaded {C2_DOCS} docs via HTTP (batched) in "
             f"{upload_s:.0f}s ({C2_DOCS/upload_s:.0f} docs/s)")
 
         def start(q):
-            return post_keepalive("/leader/start", q.encode())
+            return client.post(leader_hp, "/leader/start", q.encode())
 
         # two warm rounds: the first pays worker XLA compiles for every
         # micro-batch bucket the arrival pattern produces
@@ -640,16 +665,179 @@ def bench_cluster(rng) -> dict:
                 "latency_ms": round(lat_ms, 1), "n_docs": C2_DOCS,
                 "workers": 2, "backend": "cpu (single-TPU-client tunnel)"}
     finally:
-        for p in procs:
-            try:
-                p.kill()
-            except Exception:
-                pass
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except Exception:
-                pass
+        _kill_all(procs)
+
+
+# --------------------------------------------------------------------------
+# config 2b: cluster data plane with a TPU-BACKED worker (VERDICT r3 #1)
+# --------------------------------------------------------------------------
+
+C2T_DOCS = 100_000
+C2T_TPU_SHARE = 95_000
+C2T_VOCAB = 200_000
+C2T_AVG_LEN = 80
+C2T_CLIENTS = 128
+C2T_QUERIES = 2048
+C2T_QUERY_BATCH = 128
+C2T_LINGER_MS = 5.0
+
+
+def bench_cluster_tpu(rng) -> dict:
+    """The distributed HTTP serving path against a TPU-backed engine —
+    the reference's only serving shape (``Leader.java:39-92``) with the
+    TPU doing the scoring. The axon tunnel admits ONE TPU client, so the
+    topology is: leader (CPU, scatter-gather only) + worker0 (TPU,
+    ~95% of the corpus) + worker1 (CPU, the tail). The phased upload
+    (worker0 alone first, then worker1 joins and takes the remainder via
+    least-loaded placement) both skews the corpus onto the TPU worker
+    and exercises elastic join (SURVEY §5.3).
+
+    MUST run before this process initializes jax: the TPU worker
+    subprocess has to be the tunnel's only TPU client."""
+    import concurrent.futures
+    import json as _json
+    import socket
+    import subprocess
+    import tempfile
+
+    client = _KeepAlive()
+    post = client.post
+
+    t0 = time.perf_counter()
+    texts = make_texts(rng, C2T_DOCS, C2T_VOCAB, C2T_AVG_LEN)
+    queries = make_queries(rng, C2T_VOCAB, 3 * C2T_QUERIES)
+    log(f"[c2t] corpus in {time.perf_counter()-t0:.0f}s")
+
+    cpu_env = dict(os.environ, TFIDF_JAX_PLATFORM="cpu",
+                   JAX_PLATFORMS="cpu")
+    cpu_env.pop("XLA_FLAGS", None)
+    tpu_env = dict(os.environ)   # unpinned: finds the TPU
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "TFIDF_JAX_PLATFORM"):
+        tpu_env.pop(k, None)
+    for e in (cpu_env, tpu_env):
+        e["TFIDF_QUERY_BATCH"] = str(C2T_QUERY_BATCH)
+        e["TFIDF_BATCH_LINGER_MS"] = str(C2T_LINGER_MS)
+        e["TFIDF_FANOUT_WORKERS"] = str(2 * C2T_CLIENTS)
+
+    procs = []
+    tmp = tempfile.mkdtemp(prefix="bench_c2t_")
+
+    def spawn(args, env):
+        p = subprocess.Popen([sys.executable, "-m", "tfidf_tpu", *args],
+                             env=env, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        procs.append(p)
+        return p
+
+    try:
+        coord = _free_port()
+        spawn(["coordinator", "--listen", f"127.0.0.1:{coord}"], cpu_env)
+        _wait_until(lambda: socket.create_connection(
+            ("127.0.0.1", coord), timeout=1).close() or True)
+        ports = [_free_port() for _ in range(3)]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+
+        def node_args(i):
+            return ["serve", "--port", str(ports[i]), "--host",
+                    "127.0.0.1", "--coordinator-address",
+                    f"127.0.0.1:{coord}",
+                    "--documents-path", f"{tmp}/n{i}/docs",
+                    "--index-path", f"{tmp}/n{i}/index"]
+
+        spawn(node_args(0), cpu_env)   # leader first: wins the election
+        _wait_until(lambda: _http_get(urls[0] + "/api/status")
+                    == b"I am the leader")
+        spawn(node_args(1), tpu_env)   # the TPU worker
+        _wait_until(lambda: _json.loads(_http_get(urls[0] + "/api/services"))
+                    == [urls[1]])
+
+        leader_hp = ("127.0.0.1", ports[0])
+        groups = [[{"name": f"d{i}.txt", "text": texts[i]}
+                   for i in range(lo, min(lo + 500, C2T_TPU_SHARE))]
+                  for lo in range(0, C2T_TPU_SHARE, 500)]
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            list(ex.map(lambda g: post(
+                leader_hp, "/leader/upload-batch",
+                _json.dumps(g).encode()), groups))
+        up1_s = time.perf_counter() - t0
+        log(f"[c2t] {C2T_TPU_SHARE} docs -> TPU worker in {up1_s:.0f}s "
+            f"({C2T_TPU_SHARE/up1_s:.0f} docs/s)")
+
+        spawn(node_args(2), cpu_env)   # CPU worker joins late
+        _wait_until(lambda: len(_json.loads(
+            _http_get(urls[0] + "/api/services"))) == 2)
+        tail = [[{"name": f"d{i}.txt", "text": texts[i]}
+                 for i in range(lo, min(lo + 500, C2T_DOCS))]
+                for lo in range(C2T_TPU_SHARE, C2T_DOCS, 500)]
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            list(ex.map(lambda g: post(
+                leader_hp, "/leader/upload-batch",
+                _json.dumps(g).encode()), tail))
+
+        # force each worker's NRT commit + first compile directly: the
+        # leader's scatter RPC timeout is 10s, a cold commit is not
+        for i in (1, 2):
+            t0 = time.perf_counter()
+            post(("127.0.0.1", ports[i]), "/worker/process",
+                 b'{"query": "t0 t1"}', timeout=900.0)
+            log(f"[c2t] worker {i-1} cold commit+compile: "
+                f"{time.perf_counter()-t0:.0f}s")
+
+        def start(q):
+            return post(leader_hp, "/leader/start", q.encode())
+
+        for r in range(2):   # warm: compiles the micro-batch buckets
+            with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
+                list(ex.map(start,
+                            queries[r*C2T_QUERIES:(r+1)*C2T_QUERIES]))
+        m0 = _json.loads(_http_get(urls[1] + "/api/metrics"))
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
+            res = list(ex.map(start,
+                              queries[2*C2T_QUERIES:3*C2T_QUERIES]))
+        qps = C2T_QUERIES / (time.perf_counter() - t0)
+        m1 = _json.loads(_http_get(urls[1] + "/api/metrics"))
+        assert all(_json.loads(r) for r in res[:32]), "empty results"
+
+        lat = []
+        for q in queries[:32]:
+            t0 = time.perf_counter()
+            start(q)
+            lat.append((time.perf_counter() - t0) * 1e3)
+
+        # isolate the leader layer: same load straight at the TPU worker
+        tpu_hp = ("127.0.0.1", ports[1])
+
+        def direct(q):
+            return post(tpu_hp, "/worker/process", q.encode())
+
+        with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
+            list(ex.map(direct, queries[:C2T_QUERIES]))
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
+            list(ex.map(direct, queries[C2T_QUERIES:2 * C2T_QUERIES]))
+        direct_qps = C2T_QUERIES / (time.perf_counter() - t0)
+
+        served = (m1.get("queries_served", 0)
+                  - m0.get("queries_served", 0))
+        batches = (m1.get("query_batches", 0)
+                   - m0.get("query_batches", 0))
+        mean_batch = served / max(batches, 1)
+        lat_ms = float(np.median(lat))
+        log(f"[c2t] /leader/start: {qps:.1f} q/s ({C2T_CLIENTS} clients,"
+            f" TPU mean batch {mean_batch:.1f}); direct worker "
+            f"{direct_qps:.1f} q/s; lone-query {lat_ms:.0f}ms")
+        return {"qps": round(qps, 1),
+                "direct_worker_qps": round(direct_qps, 1),
+                "latency_ms": round(lat_ms, 1),
+                "upload_dps_tpu": round(C2T_TPU_SHARE / up1_s, 1),
+                "n_docs": C2T_DOCS, "tpu_share": C2T_TPU_SHARE,
+                "clients": C2T_CLIENTS,
+                "tpu_mean_batch": round(mean_batch, 1),
+                "workers": 2, "backend": "tpu worker + cpu worker"}
+    finally:
+        _kill_all(procs)
 
 
 # --------------------------------------------------------------------------
@@ -709,6 +897,9 @@ def bench_5m_vocab(rng) -> dict:
 
 def main() -> None:
     rng = np.random.default_rng(SEED)
+    # FIRST, before this process touches jax: the TPU-backed cluster
+    # bench — its worker subprocess must be the tunnel's only TPU client
+    c2t = bench_cluster_tpu(rng)
     # the 1M-doc corpus is shared by the north-star and streaming
     # configs (generation is ~90s; the content is identical anyway)
     corpus_1m = make_doc_arrays(rng, NS_DOCS, NS_VOCAB, NS_AVG_LEN)
@@ -752,6 +943,7 @@ def main() -> None:
             "mesh_serving_50k": mesh,
             "config5_5m_vocab": c5,
             "config2_cluster_100k_2workers": c2,
+            "config2_tpu_worker": c2t,
             "top_k": TOP_K,
         },
     }

@@ -146,3 +146,29 @@ def test_stop_fails_pending_not_hangs():
     for t in threads:
         t.join(timeout=5)
         assert not t.is_alive()
+
+
+def test_pipeline_threads_concurrent_and_stop_clean(engine):
+    """pipeline=2: concurrent queries still all answer correctly, and
+    stop() terminates BOTH scorer threads (a _take_batch clearing _wake
+    after stop() set it would park sibling threads forever —
+    code-review r4)."""
+    b = QueryBatcher(engine, max_batch=4, linger_s=0.002, pipeline=2)
+    results = {}
+
+    def run(q):
+        results[q] = b.search(q)
+
+    threads = [threading.Thread(target=run, args=(f"fox t{i}",))
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == 12
+    t0 = time.monotonic()
+    b.stop()
+    assert time.monotonic() - t0 < 2.0, "stop() stalled on parked thread"
+    for t in b._threads:
+        t.join(timeout=1.0)
+        assert not t.is_alive(), "batcher thread leaked after stop()"

@@ -9,6 +9,7 @@ and partial-result tolerance.
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -313,6 +314,52 @@ class TestClusterEndToEnd:
         assert [s["name"] for s in resp3["skipped"]] == ["bad.pdf"]
         assert "bad.pdf" not in leader._placement
         assert "good.txt" in leader._placement
+
+    def test_malformed_batch_rejected_without_state_leak(self, cluster):
+        """A doc missing 'name' must 400 BEFORE any routing state is
+        touched: a mid-planning KeyError would leak inflight counts and
+        claims for already-routed docs, pinning those names to
+        never-confirmed placements (code-review r4)."""
+        leader = cluster[0]
+        bad = [{"name": "leaky.txt", "text": "fine"}, {"text": "no name"}]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(leader.url + "/leader/upload-batch",
+                      json.dumps(bad).encode())
+        assert ei.value.code == 400
+        assert "leaky.txt" not in leader._placement
+        assert "leaky.txt" not in leader._inflight
+        assert "leaky.txt" not in leader._claims
+        # the name is still placeable afterwards
+        ok = [{"name": "leaky.txt", "text": "quokka sighting report"}]
+        resp = json.loads(http_post(leader.url + "/leader/upload-batch",
+                                    json.dumps(ok).encode()))
+        assert sum(resp["placed"].values()) == 1
+        result = json.loads(http_post(leader.url + "/leader/start",
+                                      b"quokka"))
+        assert list(result) == ["leaky.txt"]
+
+    def test_settle_failure_cleans_phantom_placement(self, cluster):
+        """When EVERY concurrent upload of a new name fails, the
+        tentative placement must not survive: a held-routed sibling
+        (token=None) settling last cleans up the unconfirmed claim
+        (code-review r4)."""
+        leader = cluster[0]
+        with leader._placement_lock:
+            w = leader.registry.get_all_service_addresses()[0]
+            tok = object()
+            leader._placement["ghost.txt"] = w
+            leader._claims["ghost.txt"] = tok
+            leader._track_inflight("ghost.txt")   # claimer in flight
+            leader._track_inflight("ghost.txt")   # held-routed sibling
+            # claimer fails first: sibling still in flight, keep state
+            leader._settle_failure("ghost.txt", tok, w)
+            assert "ghost.txt" in leader._placement
+            # sibling (token=None) fails last: unconfirmed claim means
+            # the placement was never accepted anywhere — drop both
+            leader._settle_failure("ghost.txt", None, w)
+            assert "ghost.txt" not in leader._placement
+            assert "ghost.txt" not in leader._claims
+            assert "ghost.txt" not in leader._inflight
 
     def test_large_download_streams_with_bounded_reads(self, cluster):
         """A big document flows worker -> leader -> client in bounded

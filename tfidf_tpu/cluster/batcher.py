@@ -48,7 +48,13 @@ class QueryBatcher:
     """
 
     def __init__(self, engine, max_batch: int = 32,
-                 linger_s: float = 0.002) -> None:
+                 linger_s: float = 0.002, pipeline: int = 1) -> None:
+        """``pipeline`` scorer threads run concurrent ``search_batch``
+        calls (the engine is a pure function of its snapshot, so this is
+        safe). On a high-RTT device link (remote-TPU tunnel) a second
+        in-flight batch hides one batch's result fetch under the next
+        batch's device compute — the same trick Searcher.search plays
+        across chunks, applied across micro-batches."""
         self.engine = engine
         self.max_batch = max(1, max_batch)
         self.linger_s = linger_s
@@ -56,9 +62,12 @@ class QueryBatcher:
         self._items: deque[_Waiter] = deque()
         self._wake = threading.Event()
         self._stopping = False
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="query-batcher")
-        self._thread.start()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"query-batcher-{i}")
+            for i in range(max(1, pipeline))]
+        for t in self._threads:
+            t.start()
 
     def search(self, query: str, k: int | None = None,
                unbounded: bool = False):
@@ -81,7 +90,8 @@ class QueryBatcher:
         with self._lock:
             self._stopping = True
         self._wake.set()
-        self._thread.join(timeout=2.0)
+        for t in self._threads:
+            t.join(timeout=2.0)
         # fail any stragglers rather than hanging their handler threads
         with self._lock:
             items, self._items = list(self._items), deque()
@@ -122,7 +132,9 @@ class QueryBatcher:
         (k, unbounded), up to max_batch. Items with other parameters stay
         queued in order for the next round."""
         with self._lock:
-            if not self._items:
+            if not self._items and not self._stopping:
+                # never clear after stop() set the event, or sibling
+                # pipeline threads park in _wake.wait() forever
                 self._wake.clear()
                 return []
             first = self._items.popleft()
@@ -131,6 +143,8 @@ class QueryBatcher:
                    and (self._items[0].k, self._items[0].unbounded)
                    == (first.k, first.unbounded)):
                 batch.append(self._items.popleft())
-            if not self._items:
+            if not self._items and not self._stopping:
+                # never clear after stop() set the event, or sibling
+                # pipeline threads park in _wake.wait() forever
                 self._wake.clear()
         return batch

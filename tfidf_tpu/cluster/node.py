@@ -63,6 +63,69 @@ def http_get(url: str, timeout: float = 10.0) -> bytes:
         return r.read()
 
 
+class _ScatterClient:
+    """Keep-alive HTTP POST client for the leader's per-query worker RPCs.
+
+    The reference builds a fresh ``RestTemplate`` (and TCP connection) per
+    call (``Leader.java:42,127,162``); at hundreds of scatter RPCs per
+    second the connection setup + urllib opener machinery becomes a real
+    per-query host cost. Fan-out pool threads are long-lived, so one
+    persistent connection per (thread, worker) amortizes it away. A
+    dropped keep-alive connection is retried once on a fresh one; any
+    non-2xx status raises (the caller already treats per-worker errors as
+    tolerated scatter failures)."""
+
+    # failures that mean "the keep-alive connection went stale between
+    # requests" — retried once on a fresh connection. Timeouts and other
+    # errors propagate immediately: retrying a hung worker would double
+    # the leader's per-worker scatter budget.
+    _RETRYABLE = (ConnectionResetError, ConnectionRefusedError,
+                  BrokenPipeError)
+
+    def __init__(self) -> None:
+        self._tls = threading.local()
+
+    def post(self, base: str, path: str, data: bytes,
+             timeout: float = 10.0, live: set[str] | None = None) -> bytes:
+        import http.client
+        u = urllib.parse.urlparse(base)
+        conns = getattr(self._tls, "conns", None)
+        if conns is None:
+            conns = self._tls.conns = {}
+        if live is not None:   # prune departed workers' idle sockets
+            for b in list(conns):
+                if b not in live:
+                    conns.pop(b).close()
+        retryable = self._RETRYABLE + (
+            http.client.BadStatusLine, http.client.CannotSendRequest,
+            http.client.NotConnected)
+        last: Exception | None = None
+        for _ in range(2):
+            c = conns.get(base)
+            if c is None:
+                c = conns[base] = http.client.HTTPConnection(
+                    u.hostname, u.port, timeout=timeout)
+            try:
+                c.request("POST", path, body=data, headers={
+                    "Content-Type": "application/json"})
+                r = c.getresponse()
+                body = r.read()
+                if r.status >= 300:
+                    raise RuntimeError(f"{base}{path} -> {r.status}")
+                return body
+            except RuntimeError:
+                raise
+            except retryable as e:
+                last = e
+                c.close()
+                conns.pop(base, None)
+            except Exception:
+                c.close()
+                conns.pop(base, None)
+                raise
+        raise last if last is not None else RuntimeError("post failed")
+
+
 def http_post(url: str, data: bytes, content_type: str = "application/json",
               timeout: float = 30.0, headers: dict | None = None) -> bytes:
     h = {"Content-Type": content_type}
@@ -107,14 +170,17 @@ class SearchNode:
         self.registry = ServiceRegistry(coord)
         self.election = LeaderElection(coord, callback=self)
         coord.on_session_event(self._on_session_event)
-        self._pool = ThreadPoolExecutor(max_workers=16,
-                                        thread_name_prefix="fanout")
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.config.fanout_workers,
+            thread_name_prefix="fanout")
+        self._scatter = _ScatterClient()
         # concurrent /worker/process requests coalesce into one device
         # batch (the kernels are built for [B] batches; the reference
         # scores one query per POST, Worker.java:175-186)
         self.batcher = (QueryBatcher(
             self.engine, max_batch=self.config.query_batch,
-            linger_s=self.config.batch_linger_ms / 1e3)
+            linger_s=self.config.batch_linger_ms / 1e3,
+            pipeline=self.config.batch_pipeline)
             if self.config.micro_batch else None)
         # near-real-time commit policy (Lucene NRT readers): uploads
         # defer the commit; the next search commits pending writes first,
@@ -127,6 +193,10 @@ class SearchNode:
         # sizes + in-tenure name->worker map (re-uploads route to the
         # holder, keeping one copy per name; see leader_upload)
         self._size_cache: tuple[float, dict[str, int]] = (0.0, {})
+        # worker -> monotonic eviction time: a poll STARTED before the
+        # eviction carries pre-failure data for that worker and must not
+        # resurrect it into the cache (see _ensure_sizes_fresh)
+        self._evicted: dict[str, float] = {}
         self._placement: dict[str, str] = {}
         self._claims: dict[str, object] = {}   # in-flight claim tokens
         self._inflight: dict[str, int] = {}    # uploads in flight per name
@@ -138,9 +208,8 @@ class SearchNode:
         self._placement_lock = threading.Lock()
 
         handler = type("Handler", (_NodeHandler,), {"node": self})
-        self.httpd = ThreadingHTTPServer(
+        self.httpd = _NodeServer(
             (self.config.host, self.config.port), handler)
-        self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
         # the reference builds this from POD_IP + SERVER_PORT env vars
         # (OnElectionAction.java:35-36)
@@ -264,11 +333,13 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         log.info("scatter search", query=query, workers=len(workers))
 
+        live = set(workers)
+
         def one(addr: str) -> list:
             global_injector.check("leader.worker_rpc")
             body = json.dumps({"query": query}).encode()
-            return json.loads(http_post(addr + "/worker/process", body,
-                                        timeout=10.0))
+            return json.loads(self._scatter.post(
+                addr, "/worker/process", body, timeout=10.0, live=live))
 
         merged: dict[str, float] = {}
         futures = {self._pool.submit(one, w): w for w in workers}
@@ -309,6 +380,11 @@ class SearchNode:
         and the install are under the lock."""
         now = time.monotonic()
         with self._placement_lock:
+            # prune stale eviction records (only recent ones can race a
+            # poll in flight; polls take at most the HTTP timeout)
+            for w, e in list(self._evicted.items()):
+                if now - e > 60.0:
+                    del self._evicted[w]
             ts, sizes = self._size_cache
             if (now - ts <= self._SIZE_POLL_TTL_S
                     and set(sizes) == set(workers)):
@@ -324,9 +400,16 @@ class SearchNode:
         if not polled:
             raise RuntimeError("no reachable workers")
         with self._placement_lock:
+            # drop poll results that predate a concurrent eviction: the
+            # worker answered our poll, then failed an upload — keeping
+            # its pre-failure size would resurrect a dead worker into
+            # the cache and route uploads at it until the next TTL
+            polled = {w: v for w, v in polled.items()
+                      if self._evicted.get(w, -1.0) <= now}
             ts2, cur = self._size_cache
             if ts2 <= ts:   # no fresher concurrent poll landed meanwhile
-                self._size_cache = (now, polled)
+                if polled:
+                    self._size_cache = (now, polled)
             else:
                 # a concurrent poll won the install; MERGE our results in
                 # for workers it did not cover (its registry view may
@@ -375,32 +458,47 @@ class SearchNode:
         """Record a worker-ACCEPTED placement. Caller holds
         ``_placement_lock``. Clears ANY pending claim for the name —
         the placement is confirmed now, so a failed sibling upload must
-        not release it."""
+        not release it. The size estimate is bumped only for workers
+        already present in the cache: re-inserting an evicted/unpolled
+        worker at near-zero size would defeat the set-mismatch re-poll
+        signal and min-route every new name onto it until TTL expiry."""
         self._dec_inflight(name)
         self._claims.pop(name, None)
         self._placement[name] = worker
         sizes = self._size_cache[1]
-        sizes[worker] = sizes.get(worker, 0) + nbytes
+        if worker in sizes:
+            sizes[worker] += nbytes
 
     def _settle_failure(self, name: str, token, worker: str) -> None:
         """Undo a tentative claim after a failed forward. Caller holds
-        ``_placement_lock``. Two guards prevent deleting state that is
-        not ours to delete:
+        ``_placement_lock``. Guards, in order:
 
-        * identity-compare the claim token — a worker-identity compare
-          would let a failed upload delete a CONCURRENT upload's
-          confirmed placement of the same name (held routing guarantees
-          both chose the same worker);
-        * drop the tentative placement only when NO sibling upload of
-          the name is still in flight — an in-flight sibling may yet
-          succeed at this worker, and deleting the entry under it would
-          let a third upload re-place the name on a different worker
-          (duplicate copies, double-counted in the sum-merge)."""
+        * while a sibling upload of the name is still in flight, leave
+          everything in place — the sibling may yet confirm this very
+          placement, and deleting the entry under it would let a third
+          upload re-place the name on a different worker (duplicate
+          copies, double-counted in the sum-merge);
+        * once the LAST in-flight upload settles, a still-present claim
+          means the placement was never confirmed by any worker — drop
+          both, whether this caller held the claim token (it created
+          the claim) or followed it as a held route (``token=None``;
+          the claimer failed earlier while this one was in flight).
+          Without the held-route branch a phantom placement survives:
+          every retry of the name stays pinned to a worker that never
+          accepted it;
+        * identity-compare a non-None token — a newer claim created
+          after this upload launched is not ours to delete."""
         remaining = self._dec_inflight(name)
-        if token is not None and self._claims.get(name) is token:
-            del self._claims[name]
-            if remaining <= 0 and self._placement.get(name) == worker:
-                del self._placement[name]
+        if remaining > 0:
+            return
+        tok = self._claims.get(name)
+        if tok is None:
+            return   # placement (if any) was confirmed by a success
+        if token is not None and token is not tok:
+            return   # a newer claim exists; not ours to delete
+        del self._claims[name]
+        if self._placement.get(name) == worker:
+            del self._placement[name]
 
     def leader_upload(self, filename: str, data: bytes) -> dict:
         """Least-loaded placement (``Leader.java:153-207``) with two
@@ -453,6 +551,7 @@ class SearchNode:
                 # instead of re-choosing the dead worker until TTL expiry
                 if not app_reject:
                     self._size_cache[1].pop(chosen, None)
+                    self._evicted[chosen] = time.monotonic()
             raise
         # size/placement state is confirmed only AFTER the worker accepted
         with self._placement_lock:
@@ -472,6 +571,13 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
+        # validate BEFORE any tracking: a KeyError mid-planning-loop
+        # would leak inflight counts + claims for docs already routed,
+        # pinning those names to never-confirmed placements forever
+        for d in docs:
+            if not isinstance(d, dict) or not isinstance(
+                    d.get("name"), str) or not d["name"]:
+                raise ValueError("every document needs a string 'name'")
         # plan the split with a local estimate; size-cache confirmations
         # happen only for groups a worker ACCEPTED — a failed forward
         # must not leave the leader believing the unreachable worker
@@ -519,6 +625,7 @@ class SearchNode:
                             d["name"], w_claims.get(d["name"]), w)
                     if not app_reject:      # fast re-poll on transport
                         self._size_cache[1].pop(w, None)   # failures only
+                        self._evicted[w] = time.monotonic()
                 continue
             # the worker reports per-doc UnsupportedMediaType skips —
             # those names were NOT indexed and must not enter the
@@ -578,6 +685,13 @@ class SearchNode:
             return stream.read()
         finally:
             stream.close()
+
+
+class _NodeServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # the socketserver default backlog (5) refuses connections under a
+    # concurrent-client burst; a node serves many clients at once
+    request_queue_size = 256
 
 
 class _NodeHandler(BaseHTTPRequestHandler):
@@ -726,7 +840,10 @@ class _NodeHandler(BaseHTTPRequestHandler):
                             "skipped": skipped})
             elif u.path == "/leader/upload-batch":
                 docs = json.loads(self._body().decode("utf-8"))
-                self._json(node.leader_upload_batch(docs))
+                try:
+                    self._json(node.leader_upload_batch(docs))
+                except ValueError as e:   # malformed client payload
+                    self._text(str(e), 400)
             elif u.path == "/leader/start":
                 query = self._read_query()
                 self._json(node.leader_search(query))

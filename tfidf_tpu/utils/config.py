@@ -56,6 +56,16 @@ class Config:
     # query pays while waiting for company.
     micro_batch: bool = True
     batch_linger_ms: float = 2.0
+    # Concurrent in-flight micro-batches (scorer threads). 2 hides one
+    # batch's device->host result fetch under the next batch's compute —
+    # material on high-RTT device links (remote-TPU tunnels).
+    batch_pipeline: int = 2
+    # Leader scatter fan-out thread pool. Each in-flight /leader/start
+    # holds one pool thread per worker RPC; with C concurrent clients
+    # and W workers the pool needs ~C*W threads or the scatter itself
+    # becomes the concurrency cap (and the worker micro-batcher never
+    # sees full batches).
+    fanout_workers: int = 16
 
     # --- analyzer ---
     lowercase: bool = True

@@ -1,289 +1,21 @@
-"""Probe: config-2 cluster data plane with a TPU-BACKED worker.
+"""Probe: config-2b cluster data plane with a TPU-backed worker.
 
-VERDICT r3 #1: the distributed HTTP serving path (the reference's only
-serving path, ``Leader.java:39-92``) had only ever run against CPU-backend
-engines. The axon tunnel admits ONE TPU client, so the topology here is:
-
-    coordinator (no jax)            — from-scratch znode service
-    leader      (CPU pin)           — scatter-gather + placement only
-    worker0     (TPU, unpinned)     — holds ~95% of the corpus
-    worker1     (CPU pin)           — joins late, holds the tail
-
-The phased upload (worker0 alone first, then worker1 joins and takes the
-remainder via least-loaded placement) both skews the corpus onto the TPU
-worker and exercises elastic join (SURVEY §5.3).
+Thin wrapper over :func:`bench.bench_cluster_tpu` (the canonical
+implementation and constants live there) so the topology can be
+exercised standalone without running the whole bench suite.
 
 IMPORTANT: run this as its own process with no prior jax init in the
-parent (the TPU worker subprocess must be the tunnel's only TPU client).
+parent — the TPU worker subprocess must be the axon tunnel's only TPU
+client.
 """
 
 from __future__ import annotations
 
-import concurrent.futures
-import http.client
 import json
-import os
-import socket
-import subprocess
-import sys
-import tempfile
-import threading
-import time
-import urllib.request
 
 import numpy as np
 
-from bench import make_queries, make_texts
-
-C2T_DOCS = 100_000
-C2T_TPU_SHARE = 95_000
-C2T_VOCAB = 200_000
-C2T_AVG_LEN = 80
-C2T_CLIENTS = 128
-C2T_QUERIES = 2048
-C2T_QUERY_BATCH = 128   # worker micro-batch cap (TFIDF_QUERY_BATCH)
-C2T_LINGER_MS = 5.0
-
-
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def get(url: str, timeout: float = 10.0) -> bytes:
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.read()
-
-
-def wait(pred, timeout: float = 180.0):
-    deadline = time.monotonic() + timeout
-    last = None
-    while time.monotonic() < deadline:
-        try:
-            if pred():
-                return
-        except Exception as e:
-            last = e
-        time.sleep(0.3)
-    raise AssertionError(f"timeout; last={last!r}")
-
-
-class KeepAliveClient:
-    """One persistent HTTP connection per (thread, host:port)."""
-
-    def __init__(self) -> None:
-        self.tls = threading.local()
-
-    def post(self, hostport: tuple[str, int], path: str, data: bytes,
-             timeout: float = 300.0) -> bytes:
-        key = f"conn_{hostport[1]}"
-        for _ in range(2):
-            c = getattr(self.tls, key, None)
-            if c is None:
-                c = http.client.HTTPConnection(*hostport, timeout=timeout)
-                setattr(self.tls, key, c)
-            try:
-                c.request("POST", path, body=data, headers={
-                    "Content-Type": "application/octet-stream"})
-                return c.getresponse().read()
-            except Exception:
-                c.close()
-                setattr(self.tls, key, None)
-        raise RuntimeError("post failed")
-
-
-def main() -> None:
-    rng = np.random.default_rng(7)
-    t0 = time.perf_counter()
-    texts = make_texts(rng, C2T_DOCS, C2T_VOCAB, C2T_AVG_LEN)
-    queries = make_queries(rng, C2T_VOCAB, 3 * C2T_QUERIES)
-    log(f"[c2t] corpus in {time.perf_counter()-t0:.0f}s")
-
-    cpu_env = dict(os.environ, TFIDF_JAX_PLATFORM="cpu",
-                   JAX_PLATFORMS="cpu")
-    cpu_env.pop("XLA_FLAGS", None)
-    tpu_env = dict(os.environ)   # unpinned: finds the axon TPU
-    tpu_env.pop("XLA_FLAGS", None)
-    tpu_env.pop("JAX_PLATFORMS", None)
-    tpu_env.pop("TFIDF_JAX_PLATFORM", None)
-    for e in (cpu_env, tpu_env):
-        e["TFIDF_QUERY_BATCH"] = str(C2T_QUERY_BATCH)
-        e["TFIDF_BATCH_LINGER_MS"] = str(C2T_LINGER_MS)
-        e["TFIDF_FANOUT_WORKERS"] = str(2 * C2T_CLIENTS)
-
-    procs: list[subprocess.Popen] = []
-    tmp = tempfile.mkdtemp(prefix="probe_c2t_")
-
-    def spawn(args, env, logname):
-        lf = open(f"{tmp}/{logname}.log", "wb")
-        p = subprocess.Popen([sys.executable, "-m", "tfidf_tpu", *args],
-                             env=env, stdout=lf, stderr=lf)
-        procs.append(p)
-        return p
-
-    client = KeepAliveClient()
-    result: dict = {}
-    try:
-        coord = free_port()
-        spawn(["coordinator", "--listen", f"127.0.0.1:{coord}"],
-              cpu_env, "coord")
-        wait(lambda: socket.create_connection(
-            ("127.0.0.1", coord), timeout=1).close() or True)
-
-        ports = [free_port() for _ in range(3)]
-        urls = [f"http://127.0.0.1:{p}" for p in ports]
-
-        def node_args(i):
-            return ["serve", "--port", str(ports[i]), "--host",
-                    "127.0.0.1", "--coordinator-address",
-                    f"127.0.0.1:{coord}",
-                    "--documents-path", f"{tmp}/n{i}/docs",
-                    "--index-path", f"{tmp}/n{i}/index"]
-
-        # leader first (wins the election; CPU — it only scatter-gathers)
-        spawn(node_args(0), cpu_env, "leader")
-        wait(lambda: get(urls[0] + "/api/status") == b"I am the leader")
-        # TPU worker next; wait until it registers AND its backend is up
-        t0 = time.perf_counter()
-        spawn(node_args(1), tpu_env, "worker_tpu")
-        wait(lambda: json.loads(get(urls[0] + "/api/services"))
-             == [urls[1]])
-        log(f"[c2t] TPU worker registered in "
-            f"{time.perf_counter()-t0:.0f}s")
-
-        leader_hp = ("127.0.0.1", ports[0])
-        groups = [[{"name": f"d{i}.txt", "text": texts[i]}
-                   for i in range(lo, min(lo + 500, C2T_TPU_SHARE))]
-                  for lo in range(0, C2T_TPU_SHARE, 500)]
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(8) as ex:
-            list(ex.map(lambda g: client.post(
-                leader_hp, "/leader/upload-batch",
-                json.dumps(g).encode()), groups))
-        up1_s = time.perf_counter() - t0
-        log(f"[c2t] phase 1: {C2T_TPU_SHARE} docs -> TPU worker in "
-            f"{up1_s:.0f}s ({C2T_TPU_SHARE/up1_s:.0f} docs/s)")
-
-        # CPU worker joins; least-loaded placement sends the tail to it
-        spawn(node_args(2), cpu_env, "worker_cpu")
-        wait(lambda: len(json.loads(get(urls[0] + "/api/services"))) == 2)
-        tail = [[{"name": f"d{i}.txt", "text": texts[i]}
-                 for i in range(lo, min(lo + 500, C2T_DOCS))]
-                for lo in range(C2T_TPU_SHARE, C2T_DOCS, 500)]
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(8) as ex:
-            list(ex.map(lambda g: client.post(
-                leader_hp, "/leader/upload-batch",
-                json.dumps(g).encode()), tail))
-        up2_s = time.perf_counter() - t0
-        log(f"[c2t] phase 2: {C2T_DOCS-C2T_TPU_SHARE} docs -> joined "
-            f"CPU worker in {up2_s:.0f}s")
-        sizes = {u: int(get(u + "/worker/index-size"))
-                 for u in json.loads(get(urls[0] + "/api/services"))}
-        log(f"[c2t] shard sizes (bytes): {sizes}")
-
-        # force each worker's NRT commit + first compile directly (the
-        # leader's scatter RPC timeout is 10s; a cold commit is minutes)
-        for i, u in enumerate((urls[1], urls[2])):
-            t0 = time.perf_counter()
-            hp = ("127.0.0.1", ports[1 + i])
-            client.post(hp, "/worker/process", b'{"query": "t0 t1"}',
-                        timeout=900.0)
-            log(f"[c2t] worker {i} cold commit+compile: "
-                f"{time.perf_counter()-t0:.0f}s")
-
-        def start(q: str) -> bytes:
-            return client.post(leader_hp, "/leader/start", q.encode(),
-                               timeout=600.0)
-
-        # warm rounds compile the micro-batch buckets the arrival
-        # pattern produces (power-of-two caps up to C2T_QUERY_BATCH)
-        for r in range(2):
-            t0 = time.perf_counter()
-            with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
-                list(ex.map(start, queries[r*C2T_QUERIES:(r+1)*C2T_QUERIES]))
-            log(f"[c2t] warm round {r}: "
-                f"{C2T_QUERIES/(time.perf_counter()-t0):.0f} q/s")
-
-        m0 = json.loads(get(urls[1] + "/api/metrics"))
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
-            res = list(ex.map(start, queries[2*C2T_QUERIES:3*C2T_QUERIES]))
-        qps = C2T_QUERIES / (time.perf_counter() - t0)
-        m1 = json.loads(get(urls[1] + "/api/metrics"))
-        assert all(json.loads(r) for r in res[:32]), "empty results"
-
-        lat = []
-        for q in queries[:32]:
-            t0 = time.perf_counter()
-            start(q)
-            lat.append((time.perf_counter() - t0) * 1e3)
-        lat_ms = float(np.median(lat))
-
-        # isolate the leader's cost: same client load straight at the
-        # TPU worker's /worker/process (no scatter, no merge, no second
-        # worker) — the gap between this and /leader/start is the
-        # leader + CPU-worker host cost on the shared core
-        tpu_hp = ("127.0.0.1", ports[1])
-
-        def direct(q: str) -> bytes:
-            return client.post(tpu_hp, "/worker/process", q.encode(),
-                               timeout=600.0)
-
-        with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
-            list(ex.map(direct, queries[:C2T_QUERIES]))
-        md0 = json.loads(get(urls[1] + "/api/metrics"))
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
-            list(ex.map(direct, queries[C2T_QUERIES:2 * C2T_QUERIES]))
-        direct_qps = C2T_QUERIES / (time.perf_counter() - t0)
-        md1 = json.loads(get(urls[1] + "/api/metrics"))
-        cd0 = md0.get("counters", md0)
-        cd1 = md1.get("counters", md1)
-        d_served = (cd1.get("queries_served", 0)
-                    - cd0.get("queries_served", 0))
-        d_batches = (cd1.get("query_batches", 0)
-                     - cd0.get("query_batches", 0))
-        log(f"[c2t] direct /worker/process: {direct_qps:.1f} q/s, "
-            f"mean batch {d_served/max(d_batches,1):.1f}")
-        log(f"[c2t] worker metrics keys: {sorted(md1)[:20]}")
-
-        c0 = m0.get("counters", m0)
-        c1 = m1.get("counters", m1)
-        served = c1.get("queries_served", 0) - c0.get("queries_served", 0)
-        batches = c1.get("query_batches", 0) - c0.get("query_batches", 0)
-        mean_batch = served / max(batches, 1)
-        log(f"[c2t] /leader/start: {qps:.1f} q/s with {C2T_CLIENTS} "
-            f"clients, median lone-query latency {lat_ms:.0f}ms, "
-            f"TPU worker mean batch {mean_batch:.1f} "
-            f"({batches} batches / {served} queries)")
-        result = {"qps": round(qps, 1),
-                  "direct_worker_qps": round(direct_qps, 1),
-                  "latency_ms": round(lat_ms, 1),
-                  "upload_dps_tpu": round(C2T_TPU_SHARE / up1_s, 1),
-                  "n_docs": C2T_DOCS, "tpu_share": C2T_TPU_SHARE,
-                  "clients": C2T_CLIENTS,
-                  "tpu_mean_batch": round(mean_batch, 1),
-                  "workers": 2, "backend": "tpu worker + cpu worker"}
-        print(json.dumps(result))
-    finally:
-        for p in procs:
-            try:
-                p.kill()
-            except Exception:
-                pass
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except Exception:
-                pass
-        log(f"[c2t] node logs in {tmp}")
-
+from bench import bench_cluster_tpu
 
 if __name__ == "__main__":
-    main()
+    print(json.dumps(bench_cluster_tpu(np.random.default_rng(7))))

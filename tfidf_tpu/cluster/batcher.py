@@ -132,10 +132,11 @@ class QueryBatcher:
         (k, unbounded), up to max_batch. Items with other parameters stay
         queued in order for the next round."""
         with self._lock:
-            if not self._items and not self._stopping:
-                # never clear after stop() set the event, or sibling
-                # pipeline threads park in _wake.wait() forever
-                self._wake.clear()
+            if not self._items:
+                if not self._stopping:
+                    # never clear after stop() set the event, or sibling
+                    # pipeline threads park in _wake.wait() forever
+                    self._wake.clear()
                 return []
             first = self._items.popleft()
             batch = [first]

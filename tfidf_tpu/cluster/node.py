@@ -558,8 +558,11 @@ class SearchNode:
             self._settle_success(filename, chosen, len(data))
             sizes = dict(self._size_cache[1])
         global_metrics.inc("uploads_placed")
+        # the worker may be absent from the size cache (held-route after
+        # an eviction skips the freshness poll) — never KeyError a
+        # SUCCESSFUL upload on a logging detail
         log.info("upload placed", file=filename, worker=chosen,
-                 size=sizes[chosen])
+                 size=sizes.get(chosen, -1))
         return {"worker": chosen, "sizes": sizes}
 
     def leader_upload_batch(self, docs: list[dict]) -> dict:
@@ -578,6 +581,8 @@ class SearchNode:
             if not isinstance(d, dict) or not isinstance(
                     d.get("name"), str) or not d["name"]:
                 raise ValueError("every document needs a string 'name'")
+            if not isinstance(d.get("text", ""), str):
+                raise ValueError("document 'text' must be a string")
         # plan the split with a local estimate; size-cache confirmations
         # happen only for groups a worker ACCEPTED — a failed forward
         # must not leave the leader believing the unreachable worker

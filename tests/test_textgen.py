@@ -1,0 +1,66 @@
+"""Realistic-corpus generator properties (VERDICT r3 #3 tooling).
+
+The generator feeds the realistic-text bench; these tests pin the
+contract the bench relies on: payload kinds behave as labeled (binary
+must 415 through the real ingest path, latin1 must NOT be valid UTF-8,
+html must extract to its body text) and the lexicon is real words.
+"""
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.ops.analyzer import UnsupportedMediaType, extract_text
+from tfidf_tpu.utils.textgen import RealisticCorpus, harvest_lexicon
+
+
+@pytest.fixture(scope="module")
+def lexicon():
+    words, counts = harvest_lexicon(max_words=5000)
+    return words, counts
+
+
+def test_lexicon_is_ranked_english(lexicon):
+    words, counts = lexicon
+    assert len(words) >= 1000
+    assert all(w.isalpha() and w.islower() for w in words[:100])
+    # frequency-ranked: descending counts
+    assert all(counts[i] >= counts[i + 1] for i in range(50))
+    # the most common English word shows up near the top of any
+    # English-prose harvest
+    assert "the" in words[:20]
+
+
+def test_payload_kinds_honor_their_contract(lexicon):
+    rng = np.random.default_rng(0)
+    gen = RealisticCorpus(rng, lexicon[0])
+    seen = set()
+    for _ in range(800):
+        payload, kind = gen.make_payload(
+            40, html_frac=0.2, latin1_frac=0.2, binary_frac=0.1)
+        seen.add(kind)
+        if kind == "binary":
+            with pytest.raises(UnsupportedMediaType):
+                extract_text(payload)
+        elif kind == "latin1":
+            with pytest.raises(UnicodeDecodeError):
+                payload.decode("utf-8")
+            text = extract_text(payload)
+            assert "caf\xe9" in text
+        elif kind == "html":
+            assert payload.lstrip().lower().startswith(b"<html")
+            text = extract_text(payload)
+            assert "<p>" not in text and "margin" not in text
+            assert len(text.split()) > 5
+        else:
+            assert extract_text(payload) == payload.decode("utf-8")
+    assert seen == {"plain", "html", "latin1", "binary"}
+
+
+def test_text_shape(lexicon):
+    rng = np.random.default_rng(1)
+    gen = RealisticCorpus(rng, lexicon[0])
+    text = "\n".join(gen.make_text(80) for _ in range(20))
+    assert "." in text and "," in text
+    assert any(c.isdigit() for c in text)
+    assert "'" in text
+    assert any(w[0].isupper() for w in text.split())

@@ -25,6 +25,7 @@ from tfidf_tpu.ops.analyzer import (Analyzer, UnsupportedMediaType,
                                     extract_text)
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.logging import Stopwatch, get_logger
+from tfidf_tpu.utils.metrics import global_metrics
 from tfidf_tpu.utils.tracing import trace_phase
 
 log = get_logger("engine")
@@ -141,9 +142,14 @@ class Engine:
             if self.native is not None:
                 res = self.native.analyze(text, add=True)
                 if res is not None:
+                    # observable fast-path hit rate: the native tokenizer
+                    # handles ASCII documents; non-ASCII falls through to
+                    # the (bit-identical) Python analyzer below
+                    global_metrics.inc("ingest_native_fast_path")
                     ids, tfs, length = res
                     self.index.add_document_arrays(name, ids, tfs, length)
                     return
+            global_metrics.inc("ingest_python_fallback")
             counts = self.analyzer.counts(text)
             length = float(sum(counts.values()))
             id_counts = self.vocab.map_counts(counts, add=True)

@@ -1,0 +1,87 @@
+"""Checkpoint save/restore at 1M docs (VERDICT r3 #5).
+
+Round 3's restore replayed 1M documents through a per-doc Python loop
+(39.2s end-to-end); the packed bulk path (engine/checkpoint.py
+``_to_coo_packed``) builds the index arrays directly from ``docs.npz``.
+This probe measures save + restore + parity at the north-star shape and
+records the numbers for PERF.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), ".jax_cache"))
+
+from bench import NS_VOCAB, make_doc_arrays, make_queries  # noqa: E402
+
+N_DOCS = int(os.environ.get("PROBE_DOCS", 1_000_000))
+AVG_LEN = 120
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    from tfidf_tpu.engine import Engine
+    from tfidf_tpu.engine.checkpoint import (load_checkpoint,
+                                             save_checkpoint)
+    from tfidf_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    offsets, ids, tfs, lengths = make_doc_arrays(rng, N_DOCS, NS_VOCAB,
+                                                 AVG_LEN)
+    engine = Engine(Config(query_batch=64))
+    for i in range(NS_VOCAB):
+        engine.vocab.add(f"t{i}")
+    t0 = time.perf_counter()
+    add = engine.index.add_document_arrays
+    for i in range(N_DOCS):
+        lo, hi = offsets[i], offsets[i + 1]
+        add(f"d{i}", ids[lo:hi], tfs[lo:hi], float(lengths[i]))
+    engine.commit()
+    log(f"[ckpt] built {N_DOCS}-doc engine in "
+        f"{time.perf_counter()-t0:.0f}s")
+    queries = make_queries(rng, NS_VOCAB, 64)
+    want = engine.search_batch(queries, k=10)
+
+    tmp = tempfile.mkdtemp(prefix="probe_ckpt_")
+    try:
+        t0 = time.perf_counter()
+        save_checkpoint(engine, tmp)
+        save_s = time.perf_counter() - t0
+        del engine
+        t0 = time.perf_counter()
+        restored = load_checkpoint(tmp, Config(query_batch=64))
+        load_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        got = restored.search_batch(queries, k=10)
+        first_search_s = time.perf_counter() - t0
+        for w, g in zip(want, got):
+            assert [h.name for h in w] == [h.name for h in g]
+            np.testing.assert_allclose([h.score for h in w],
+                                       [h.score for h in g], rtol=1e-6)
+        out = {"n_docs": N_DOCS, "nnz": int(ids.shape[0]),
+               "save_s": round(save_s, 1),
+               "restore_s": round(load_s, 1),
+               "first_search_s": round(first_search_s, 1),
+               "parity_checked": True}
+        log(f"[ckpt] save {save_s:.1f}s, restore {load_s:.1f}s, "
+            f"first search {first_search_s:.1f}s, top-10 identical "
+            f"on {len(queries)} queries")
+        print(json.dumps(out))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

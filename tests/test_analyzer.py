@@ -106,6 +106,118 @@ def test_extract_pdf_flate_and_tj_array():
     assert "Hello" in out and "World" in out
 
 
+def _cid_pdf(text: str, *, compress_cmap: bool = False,
+             literal: bool = False) -> bytes:
+    """A CID-encoded PDF: show strings are 2-byte glyph ids, readable
+    only through the font's /ToUnicode CMap (the shape Tika handles and
+    round 3 refused with 415 — VERDICT r3 #8)."""
+    import zlib
+
+    # glyph id = codepoint + 0x100 so raw bytes are NOT latin-1 text
+    codes = [ord(c) + 0x100 for c in text]
+    pairs = "\n".join(f"<{c:04x}> <{ord(ch):04x}>"
+                      for c, ch in zip(codes, text))
+    cmap = (b"/CIDInit /ProcSet findresource begin\n"
+            b"begincmap\n"
+            b"1 begincodespacerange\n<0000> <ffff> endcodespacerange\n"
+            + f"{len(codes)} beginbfchar\n{pairs}\nendbfchar\n".encode()
+            + b"endcmap\nend\n")
+    if compress_cmap:
+        cmap = zlib.compress(cmap)
+    if literal:
+        raw = b"".join(c.to_bytes(2, "big") for c in codes)
+        esc = (raw.replace(b"\\", b"\\\\").replace(b"(", b"\\(")
+               .replace(b")", b"\\)"))
+        content = b"BT /F1 12 Tf (" + esc + b") Tj ET"
+    else:
+        hexstr = "".join(f"{c:04x}" for c in codes).encode()
+        content = b"BT /F1 12 Tf <" + hexstr + b"> Tj ET"
+    return (b"%PDF-1.4\n"
+            b"1 0 obj\n<< /Type /Font /ToUnicode 2 0 R >>\nendobj\n"
+            b"2 0 obj\n<< /Length " + str(len(cmap)).encode()
+            + b" >>\nstream\n" + cmap + b"endstream\nendobj\n"
+            b"3 0 obj\n<< /Length " + str(len(content)).encode()
+            + b" >>\nstream\n" + content + b"endstream\nendobj\n"
+            b"%%EOF\n")
+
+
+def test_extract_pdf_cid_hex_tounicode():
+    out = extract_text(_cid_pdf("Hidden cid words"))
+    assert "Hidden cid words" in out
+
+
+def test_extract_pdf_cid_compressed_cmap():
+    out = extract_text(_cid_pdf("flate mapped text", compress_cmap=True))
+    assert "flate mapped text" in out
+
+
+def test_extract_pdf_cid_literal_string():
+    """CID codes inside a LITERAL (...) Tj string: the bytes decode as
+    garbage latin-1 but map cleanly through the CMap — the CMap must
+    win."""
+    out = extract_text(_cid_pdf("literal cid run", literal=True))
+    assert "literal cid run" in out
+
+
+def test_extract_pdf_cid_bfrange():
+    import zlib
+    text = "abcdef"
+    # one bfrange covering a-f: <0161> <0166> <0061>
+    cmap = (b"begincmap\n1 begincodespacerange\n<0000> <ffff> "
+            b"endcodespacerange\n1 beginbfrange\n"
+            b"<0161> <0166> <0061>\nendbfrange\nendcmap\n")
+    codes = [ord(c) + 0x100 for c in text]
+    hexstr = "".join(f"{c:04x}" for c in codes).encode()
+    content = b"BT <" + hexstr + b"> Tj ET"
+    pdf = (b"%PDF-1.4\n"
+           b"1 0 obj\n<< /Type /Font /ToUnicode 2 0 R >>\nendobj\n"
+           b"2 0 obj\n<< >>\nstream\n" + cmap
+           + b"endstream\nendobj\n"
+           b"3 0 obj\n<< >>\nstream\n" + content
+           + b"endstream\nendobj\n%%EOF\n")
+    assert "abcdef" in extract_text(pdf)
+    # same but with a compressed content stream
+    pdf2 = pdf.replace(b"stream\n" + content,
+                       b"stream\n" + zlib.compress(content))
+    assert "abcdef" in extract_text(pdf2)
+
+
+def test_extract_pdf_cid_mixed_bfrange_forms():
+    """A bfrange section mixing array-form and consecutive-form entries
+    must parse both correctly — stripping only the brackets would leave
+    an orphan <lo> <hi> pair that mis-pairs with the next entry
+    (code-review r4)."""
+    cmap = (b"begincmap\n1 begincodespacerange\n<0000> <ffff> "
+            b"endcodespacerange\n2 beginbfrange\n"
+            b"<0001> <0003> [<0041> <0042> <0043>]\n"
+            b"<0010> <0012> <0061>\n"
+            b"endbfrange\nendcmap\n")
+    #  codes 1-3 -> ABC (array form); 0x10-0x12 -> abc (consecutive)
+    content = b"BT <000100020003> Tj <001000110012> Tj ET"
+    pdf = (b"%PDF-1.4\n"
+           b"1 0 obj\n<< /Type /Font /ToUnicode 2 0 R >>\nendobj\n"
+           b"2 0 obj\n<< >>\nstream\n" + cmap + b"endstream\nendobj\n"
+           b"3 0 obj\n<< >>\nstream\n" + content
+           + b"endstream\nendobj\n%%EOF\n")
+    out = extract_text(pdf)
+    assert "ABC" in out and "abc" in out
+
+
+def test_extract_pdf_unmapped_cids_still_rejected():
+    """Hex show strings whose codes have NO ToUnicode coverage must not
+    be indexed as glyph-id noise; with no other text the PDF 415s."""
+    import pytest
+
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
+    content = b"BT <0501050205030504> Tj ET"
+    pdf = (b"%PDF-1.4\n1 0 obj\n<< /Length "
+           + str(len(content)).encode() + b" >>\nstream\n" + content
+           + b"endstream\nendobj\n%%EOF\n")
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(pdf)
+
+
 def test_extract_pdf_without_text_rejected():
     import pytest
 

@@ -168,7 +168,10 @@ def test_pipeline_threads_concurrent_and_stop_clean(engine):
     assert len(results) == 12
     t0 = time.monotonic()
     b.stop()
-    assert time.monotonic() - t0 < 2.0, "stop() stalled on parked thread"
+    # a parked thread makes stop() eat the full 2s join timeout PER
+    # thread (4s at pipeline=2); stay below that signature with slack
+    # for CPU-contended CI hosts
+    assert time.monotonic() - t0 < 3.5, "stop() stalled on parked thread"
     for t in b._threads:
         t.join(timeout=1.0)
         assert not t.is_alive(), "batcher thread leaked after stop()"

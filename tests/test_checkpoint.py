@@ -125,3 +125,45 @@ def test_bulk_restore_equals_per_doc_replay(tmp_path):
     assert "file1.txt" not in names_after      # re-written content
     assert "extra0.txt" not in names_after     # deleted
     assert "extra1.txt" in names_after
+
+
+def test_fast_snapshot_restore_and_signature_guard(tmp_path):
+    """load installs the checkpointed snapshot arrays (no re-layout)
+    when the scoring config matches, and falls back to a full commit —
+    with correct scores for the NEW config — when it does not."""
+    import os
+
+    e = make_engine(tmp_path)
+    for i, text in enumerate(["alpha beta gamma", "beta gamma delta",
+                              "gamma delta epsilon", "alpha alpha beta"]):
+        e.ingest_text(f"f{i}.txt", text)
+    e.commit()
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+    assert os.path.exists(os.path.join(ckpt, "snapshot.npz"))
+    want = [(h.name, round(h.score, 5)) for h in e.search("beta gamma")]
+
+    fast = load_checkpoint(ckpt, e.config)
+    # the installed snapshot IS the committed state: version preserved,
+    # and a follow-up commit() is a no-op (clean generation)
+    v0 = fast.index.snapshot.version
+    fast.commit()
+    assert fast.index.snapshot.version == v0
+    got = [(h.name, round(h.score, 5)) for h in fast.search("beta gamma")]
+    assert got == want
+
+    # different scoring config -> signature mismatch -> full commit with
+    # scores that match a from-scratch engine under that config
+    other_cfg = e.config.replace(bm25_k1=0.9)
+    slow = load_checkpoint(ckpt, other_cfg)
+    ref = make_engine(tmp_path / "ref", bm25_k1=0.9)
+    for i, text in enumerate(["alpha beta gamma", "beta gamma delta",
+                              "gamma delta epsilon", "alpha alpha beta"]):
+        ref.ingest_text(f"f{i}.txt", text)
+    ref.commit()
+    got2 = [(h.name, round(h.score, 5))
+            for h in slow.search("beta gamma")]
+    want2 = [(h.name, round(h.score, 5))
+             for h in ref.search("beta gamma")]
+    assert got2 == want2
+    assert got2 != want    # k1 change really changed the scores

@@ -38,8 +38,20 @@ log = get_logger("engine.checkpoint")
 FORMAT_VERSION = 1
 
 
+def _score_signature(engine: Engine) -> list:
+    """Everything the precomputed snapshot arrays depend on: restoring
+    them under a different scoring config would silently serve wrong
+    scores, so load falls back to a full commit on any mismatch."""
+    c = engine.config
+    return [engine.model.kind, c.bm25_k1, c.bm25_b, c.lucene_parity,
+            c.scoring_layout, c.ell_width_cap]
+
+
 def save_checkpoint(engine: Engine, directory: str) -> None:
-    entries = engine.index.live_entries()
+    if hasattr(engine.index, "live_entries_and_gen"):
+        entries, entries_gen = engine.index.live_entries_and_gen()
+    else:
+        entries, entries_gen = engine.index.live_entries(), None
     n = len(entries)
     offsets = np.zeros(n + 1, np.int64)
     for i, d in enumerate(entries):
@@ -70,6 +82,29 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
              offsets=offsets, term_ids=term_ids, tfs=tfs, lengths=lengths)
     with open(os.path.join(vdir, "names.json"), "w", encoding="utf-8") as f:
         json.dump([d.name for d in entries], f)
+    # fast-restore payload: the committed snapshot's device arrays, so
+    # load skips the O(corpus) host COO/ELL re-layout (VERDICT r3 #5).
+    # The snapshot's doc order is its own (width-sorted); store it as a
+    # permutation into names.json instead of duplicating 1M names.
+    snap_meta = None
+    exported = (engine.index.export_snapshot_arrays()
+                if engine.config.checkpoint_snapshot_arrays
+                and hasattr(engine.index, "export_snapshot_arrays")
+                and entries_gen is not None
+                else None)
+    if exported is not None:
+        arrays, snap_names, snap_gen = exported
+        pos = {name: i for i, name in enumerate(d.name for d in entries)}
+        # the gen token proves the doc table (docs.npz) and the exported
+        # snapshot describe the SAME corpus: a concurrent re-ingest of
+        # an existing name + commit between the two reads would pass the
+        # name-set guard while the contents diverged
+        if (snap_gen == entries_gen and len(snap_names) == n
+                and all(nm in pos for nm in snap_names)):
+            arrays["name_order"] = np.fromiter(
+                (pos[nm] for nm in snap_names), np.int64, n)
+            np.savez(os.path.join(vdir, "snapshot.npz"), **arrays)
+            snap_meta = {"score_signature": _score_signature(engine)}
     with open(os.path.join(vdir, "meta.json"), "w", encoding="utf-8") as f:
         json.dump({
             "format_version": FORMAT_VERSION,
@@ -77,6 +112,7 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
             "num_docs": n,
             "nnz": nnz,
             "vocab_size": len(engine.vocab),
+            "snapshot": snap_meta,
         }, f)
     fault_point("checkpoint.pre_publish")   # crash window for fault tests
     # Atomic publish: swing the symlink in one os.replace. <base> always
@@ -133,6 +169,25 @@ def load_checkpoint(directory: str, config: Config | None = None) -> Engine:
         for i, name in enumerate(names):
             add(name, term_ids[lo_list[i]:hi_list[i]],
                 tfs[lo_list[i]:hi_list[i]], len_list[i])
-    engine.commit()
-    log.info("checkpoint loaded", dir=directory, docs=len(names))
+    # fast path: re-upload the checkpointed snapshot arrays instead of
+    # re-running the O(corpus) host layout — only when the scoring
+    # config matches what the arrays were built under, and the vocab
+    # capacity agrees with the stored df (a bigger live vocab needs a
+    # rebuilt snapshot)
+    snap_path = os.path.join(directory, "snapshot.npz")
+    installed = False
+    snap_meta = meta.get("snapshot")
+    if (snap_meta is not None and os.path.exists(snap_path)
+            and hasattr(engine.index, "install_snapshot_arrays")
+            and snap_meta.get("score_signature")
+            == _score_signature(engine)):
+        data = np.load(snap_path)
+        if int(data["df"].shape[0]) == engine.vocab.capacity():
+            snap_names = [names[i] for i in data["name_order"]]
+            engine.index.install_snapshot_arrays(data, snap_names)
+            installed = True
+    if not installed:
+        engine.commit()
+    log.info("checkpoint loaded", dir=directory, docs=len(names),
+             fast_snapshot=installed)
     return engine

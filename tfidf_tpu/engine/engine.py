@@ -117,7 +117,9 @@ class Engine:
                 min_doc_cap=c.min_doc_capacity,
                 ell_width_cap=c.ell_width_cap,
                 max_segments=c.max_segments,
-                sync_merge_nnz=c.sync_merge_nnz)
+                sync_merge_nnz=c.sync_merge_nnz,
+                merge_upload_pace=c.merge_upload_pace,
+                merge_workers=c.merge_workers)
         else:
             self.index = ShardIndex(
                 self.model,

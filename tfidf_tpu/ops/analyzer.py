@@ -102,11 +102,13 @@ class UnsupportedMediaType(ValueError):
     covers; the HTTP layer maps this to 415 Unsupported Media Type."""
 
 
-_PDF_ESCAPES = {b"n": "\n", b"r": "\r", b"t": "\t", b"b": " ",
-                b"f": " ", b"(": "(", b")": ")", b"\\": "\\"}
+_PDF_ESCAPES = {b"n": b"\n", b"r": b"\r", b"t": b"\t", b"b": b" ",
+                b"f": b" ", b"(": b"(", b")": b")", b"\\": b"\\"}
 
 
-def _pdf_unescape(raw: bytes) -> str:
+def _pdf_unescape_bytes(raw: bytes) -> bytes:
+    """Literal-string escapes -> raw string bytes (encoding-agnostic:
+    the bytes may be Latin-1 text OR 2-byte CID codes)."""
     out = []
     i = 0
     while i < len(raw):
@@ -118,39 +120,190 @@ def _pdf_unescape(raw: bytes) -> str:
                 while j < min(i + 4, len(raw)) and raw[j:j + 1].isdigit():
                     j += 1
                 try:
-                    out.append(chr(int(raw[i + 1:j], 8)))
+                    out.append(bytes([int(raw[i + 1:j], 8) & 0xFF]))
                 except ValueError:
                     pass
                 i = j
                 continue
-            out.append(_PDF_ESCAPES.get(nxt, nxt.decode("latin-1")))
+            out.append(_PDF_ESCAPES.get(nxt, nxt))
             i += 2
             continue
-        out.append(c.decode("latin-1"))
+        out.append(c)
         i += 1
-    return "".join(out)
+    return b"".join(out)
 
 
-def _extract_pdf(data: bytes) -> str:
-    """Minimal PDF text pull: FlateDecode content streams, ``(...) Tj``
-    and ``[...] TJ`` text-showing operators. Covers straightforwardly
-    generated PDFs; exotic encodings yield no text and are rejected by
-    the caller rather than indexed as garbage."""
+def _utf16be_hex(h: str) -> str:
+    """ToUnicode destination hex -> text (UTF-16BE code units)."""
+    if len(h) % 2:
+        h = "0" + h
+    return bytes.fromhex(h).decode("utf-16-be", "ignore")
+
+
+def _parse_tounicode(cmap_bytes: bytes) -> tuple[dict[int, str], int]:
+    """Parse a ToUnicode CMap stream (``beginbfchar``/``beginbfrange``
+    sections) into ``(code -> text, code_byte_length)`` — the mapping
+    Tika applies for CID-encoded PDFs (``Worker.java:198-212``)."""
+    text = cmap_bytes.decode("latin-1", "replace")
+    out: dict[int, str] = {}
+    code_len = 2
+    for m in re.finditer(r"begincodespacerange(.*?)endcodespacerange",
+                         text, re.S):
+        src = re.findall(r"<([0-9A-Fa-f]+)>", m.group(1))
+        if src:
+            code_len = max(1, len(src[0]) // 2)
+    for m in re.finditer(r"beginbfchar(.*?)endbfchar", text, re.S):
+        for src, dst in re.findall(
+                r"<([0-9A-Fa-f]+)>\s*<([0-9A-Fa-f]+)>", m.group(1)):
+            out[int(src, 16)] = _utf16be_hex(dst)
+            code_len = max(1, len(src) // 2)
+    for m in re.finditer(r"beginbfrange(.*?)endbfrange", text, re.S):
+        body = m.group(1)
+        for lo, _hi, arr in re.findall(
+                r"<([0-9A-Fa-f]+)>\s*<([0-9A-Fa-f]+)>\s*\[(.*?)\]",
+                body, re.S):
+            for k, dst in enumerate(re.findall(r"<([0-9A-Fa-f]+)>",
+                                               arr)):
+                out[int(lo, 16) + k] = _utf16be_hex(dst)
+            code_len = max(1, len(lo) // 2)
+        # strip WHOLE array-form entries first (<lo> <hi> [..] — not
+        # just the bracket, which would leave an orphan <lo> <hi> pair
+        # to mis-pair with the next entry): their [<dst> ...] bodies
+        # would otherwise match the three-hex pattern and inject bogus
+        # mappings that override legitimate bfchar entries
+        flat = re.sub(r"<[0-9A-Fa-f]+>\s*<[0-9A-Fa-f]+>\s*\[.*?\]",
+                      " ", body, flags=re.S)
+        for lo, hi, dst in re.findall(
+                r"<([0-9A-Fa-f]+)>\s*<([0-9A-Fa-f]+)>\s*"
+                r"<([0-9A-Fa-f]+)>", flat):
+            lo_i, hi_i = int(lo, 16), int(hi, 16)
+            if hi_i - lo_i > 0xFFFF:
+                continue   # malformed range; refuse to build 64k+ junk
+            base = int(dst, 16)
+            width = len(dst)
+            for k in range(hi_i - lo_i + 1):
+                out[lo_i + k] = _utf16be_hex(format(base + k,
+                                                    f"0{width}x"))
+            code_len = max(1, len(lo) // 2)
+    return out, code_len
+
+
+def _collect_tounicode(data: bytes, streams: list[bytes]
+                       ) -> tuple[dict[int, str], int]:
+    """Union of every ToUnicode CMap in the document.
+
+    Per-font tracking (following ``Tf`` operators) is what Tika does;
+    merging all maps covers the dominant single-embedded-font case and
+    disjoint CID spaces, and a collision merely swaps glyphs of the
+    same document's fonts — acceptable for search-text extraction."""
+    merged: dict[int, str] = {}
+    code_len = 2
+    # streams referenced as "/ToUnicode N 0 R": resolve object N, else
+    # fall back to any stream that contains CMap markers
+    ref_objs = set(re.findall(rb"/ToUnicode\s+(\d+)\s+0\s+R", data))
+    bodies: list[bytes] = []
+    if ref_objs:
+        for num in ref_objs:
+            # anchor the object number: "2 0 obj" must not match inside
+            # "12 0 obj"
+            m = re.search(rb"(?<!\d)" + num + rb"\s+0\s+obj(.*?)endobj",
+                          data, re.S)
+            if m is not None:
+                sm = re.search(rb"stream\r?\n(.*?)endstream",
+                               m.group(1), re.S)
+                if sm is not None:
+                    bodies.append(sm.group(1))
+    bodies.extend(s for s in streams if b"beginbfchar" in s
+                  or b"beginbfrange" in s)
     import zlib
-
-    texts: list[str] = []
-    for m in re.finditer(rb"stream\r?\n(.*?)endstream", data, re.S):
-        raw = m.group(1)
+    seen: set[bytes] = set()
+    for raw in bodies:
+        # dedupe by CONTENT: the ref-resolved body and the marker-scan
+        # fallback yield distinct bytes objects for the same stream
+        if raw in seen:
+            continue
+        seen.add(raw)
         try:
             raw = zlib.decompress(raw)
         except Exception:
             pass
+        if b"beginbfchar" not in raw and b"beginbfrange" not in raw:
+            continue
+        cmap, cl = _parse_tounicode(raw)
+        if cmap:
+            merged.update(cmap)
+            code_len = cl
+    return merged, code_len
+
+
+def _decode_cids(raw: bytes, cmap: dict[int, str], code_len: int
+                 ) -> str | None:
+    """Decode show-string bytes as CID codes through the ToUnicode map.
+    Returns None unless most codes map — emitting unmapped glyph ids
+    would index noise."""
+    if not cmap or not raw:
+        return None
+    n = len(raw) // code_len
+    if n == 0:
+        return None
+    codes = [int.from_bytes(raw[i * code_len:(i + 1) * code_len], "big")
+             for i in range(n)]
+    hits = [cmap[c] for c in codes if c in cmap]
+    if len(hits) < max(1, int(0.8 * n)):
+        return None
+    return "".join(hits)
+
+
+def _extract_pdf(data: bytes) -> str:
+    """PDF text pull: FlateDecode content streams, ``(...) Tj`` /
+    ``[...] TJ`` literal strings, and CID/ToUnicode-encoded text —
+    ``<hex> Tj`` show strings (and hex entries in TJ arrays) decoded
+    through the document's ToUnicode CMaps, plus literal strings whose
+    bytes map as CID codes. Exotic encodings with no ToUnicode data
+    yield no text and are rejected by the caller rather than indexed
+    as garbage (Tika-parity contract, ``Worker.java:198-212``)."""
+    import zlib
+
+    streams: list[bytes] = [
+        m.group(1) for m in re.finditer(rb"stream\r?\n(.*?)endstream",
+                                        data, re.S)]
+    cmap, code_len = _collect_tounicode(data, streams)
+
+    def show(raw_bytes: bytes) -> str:
+        cid = _decode_cids(raw_bytes, cmap, code_len)
+        if cid is not None:
+            return cid
+        return raw_bytes.decode("latin-1")
+
+    texts: list[str] = []
+    for raw in streams:
+        try:
+            raw = zlib.decompress(raw)
+        except Exception:
+            pass
+        if b"beginbfchar" in raw or b"beginbfrange" in raw:
+            continue   # a CMap stream, not page content
         for t in re.finditer(rb"\(((?:\\.|[^\\()])*)\)\s*Tj", raw, re.S):
-            texts.append(_pdf_unescape(t.group(1)))
-        for arr in re.finditer(rb"\[((?:\\.|[^\]])*)\]\s*TJ", raw, re.S):
-            for t in re.finditer(rb"\(((?:\\.|[^\\()])*)\)",
-                                 arr.group(1), re.S):
-                texts.append(_pdf_unescape(t.group(1)))
+            texts.append(show(_pdf_unescape_bytes(t.group(1))))
+        for t in re.finditer(rb"<([0-9A-Fa-f\s]+)>\s*Tj", raw):
+            h = re.sub(rb"\s", rb"", t.group(1)).decode()
+            decoded = _decode_cids(
+                bytes.fromhex(h if len(h) % 2 == 0 else h + "0"),
+                cmap, code_len)
+            if decoded is not None:
+                texts.append(decoded)
+        for arr in re.finditer(rb"\[((?:\\.|<[^>]*>|[^\]])*)\]\s*TJ",
+                               raw, re.S):
+            body = arr.group(1)
+            for t in re.finditer(rb"\(((?:\\.|[^\\()])*)\)", body, re.S):
+                texts.append(show(_pdf_unescape_bytes(t.group(1))))
+            for t in re.finditer(rb"<([0-9A-Fa-f\s]+)>", body):
+                h = re.sub(rb"\s", rb"", t.group(1)).decode()
+                decoded = _decode_cids(
+                    bytes.fromhex(h if len(h) % 2 == 0 else h + "0"),
+                    cmap, code_len)
+                if decoded is not None:
+                    texts.append(decoded)
     return " ".join(texts)
 
 

@@ -203,6 +203,30 @@ def test_extract_pdf_cid_mixed_bfrange_forms():
     assert "ABC" in out and "abc" in out
 
 
+def test_extract_pdf_mixed_code_width_fonts():
+    """A 2-byte CID font and a 1-byte simple-font ToUnicode in one PDF:
+    per-width CMap maps keep the 2-byte show strings decoding at the
+    right width regardless of CMap parse order (code-review r4)."""
+    cmap2 = (b"begincmap\n1 begincodespacerange\n<0000> <ffff> "
+             b"endcodespacerange\n2 beginbfchar\n"
+             b"<0141> <0058>\n<0142> <0059>\nendbfchar\nendcmap\n")
+    cmap1 = (b"begincmap\n1 begincodespacerange\n<00> <ff> "
+             b"endcodespacerange\n2 beginbfchar\n"
+             b"<41> <0061>\n<42> <0062>\nendbfchar\nendcmap\n")
+    content = b"BT <01410142> Tj <4142> Tj ET"
+    pdf = (b"%PDF-1.4\n"
+           b"1 0 obj\n<< /Type /Font /ToUnicode 3 0 R >>\nendobj\n"
+           b"2 0 obj\n<< /Type /Font /ToUnicode 4 0 R >>\nendobj\n"
+           b"3 0 obj\n<< >>\nstream\n" + cmap2 + b"endstream\nendobj\n"
+           b"4 0 obj\n<< >>\nstream\n" + cmap1 + b"endstream\nendobj\n"
+           b"5 0 obj\n<< >>\nstream\n" + content
+           + b"endstream\nendobj\n%%EOF\n")
+    out = extract_text(pdf)
+    # 2-byte codes 0x0141,0x0142 -> XY (not split into 1-byte a,b);
+    # 1-byte codes 0x41,0x42 -> ab
+    assert "XY" in out and "ab" in out
+
+
 def test_extract_pdf_unmapped_cids_still_rejected():
     """Hex show strings whose codes have NO ToUnicode coverage must not
     be indexed as glyph-id noise; with no other text the PDF 415s."""

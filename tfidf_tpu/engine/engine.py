@@ -96,7 +96,8 @@ class Engine:
                     self.index, self.analyzer, self.vocab, self.model,
                     query_batch=c.query_batch,
                     max_query_terms=c.max_query_terms,
-                    top_k=c.top_k, result_order=c.result_order)
+                    top_k=c.top_k, result_order=c.result_order,
+                    pipeline_depth=c.search_pipeline_depth)
                 return
             self.index = MeshIndex(
                 self.model, mesh=mesh,
@@ -109,7 +110,8 @@ class Engine:
                 top_k=c.top_k, result_order=c.result_order,
                 # parity mode scores each shard against local statistics,
                 # as every Java worker does (Worker.java:222-241)
-                global_idf=not c.lucene_parity)
+                global_idf=not c.lucene_parity,
+                pipeline_depth=c.search_pipeline_depth)
             return
         if c.index_mode == "segments":
             self.index = SegmentedIndex(
@@ -131,7 +133,8 @@ class Engine:
             self.index, self.analyzer, self.vocab, self.model,
             query_batch=c.query_batch, max_query_terms=c.max_query_terms,
             top_k=c.top_k, result_order=c.result_order,
-            use_pallas=c.use_pallas)
+            use_pallas=c.use_pallas,
+            pipeline_depth=c.search_pipeline_depth)
 
     # ---- ingest (Worker.upload / addDocToIndex analog) ----
 

@@ -77,7 +77,11 @@ class QueryVectorizerMixin:
     with ``min_slots`` floored at the largest u_cap seen so far, so the
     compiled scoring program stays stable across query batches instead
     of recompiling whenever the unique count crosses a power-of-two
-    bucket. Hosts must provide analyzer/vocab/model/max_query_terms."""
+    bucket. Hosts must provide analyzer/vocab/model/max_query_terms.
+
+    Also hosts the ONE implementation of depth-N chunk pipelining
+    (``_run_pipelined``) so the engine and mesh search loops cannot
+    drift."""
 
     _u_floor = 256
 
@@ -89,13 +93,33 @@ class QueryVectorizerMixin:
         self._u_floor = max(self._u_floor, qb.uniq.shape[0])
         return qb, widest
 
+    def _run_pipelined(self, chunks, dispatch, finish) -> list:
+        """Run ``dispatch(chunk) -> state`` over chunks keeping up to
+        ``pipeline_depth`` states in flight before ``finish(*state)``
+        collects each — later chunks' device programs launch before
+        earlier chunks' results are fetched, hiding the device->host
+        RTT under compute."""
+        from collections import deque
+
+        depth = getattr(self, "pipeline_depth", 1)
+        pending: deque = deque()
+        out: list = []
+        for chunk in chunks:
+            pending.append(dispatch(chunk))
+            if len(pending) > depth:
+                out.extend(finish(*pending.popleft()))
+        while pending:
+            out.extend(finish(*pending.popleft()))
+        return out
+
 
 class Searcher(QueryVectorizerMixin):
     def __init__(self, index: ShardIndex, analyzer: Analyzer,
                  vocab: Vocabulary, model: ScoringModel,
                  *, query_batch: int = 32, max_query_terms: int = 32,
                  top_k: int = 10, result_order: str = "score",
-                 use_pallas: bool = False) -> None:
+                 use_pallas: bool = False,
+                 pipeline_depth: int = 2) -> None:
         self.index = index
         self.analyzer = analyzer
         self.vocab = vocab
@@ -107,6 +131,12 @@ class Searcher(QueryVectorizerMixin):
         # (Leader.java:80-91 sorts the merged map by document name)
         self.result_order = result_order
         self.use_pallas = use_pallas
+        # in-flight chunks: on small corpora the device step is far
+        # shorter than the device->host fetch RTT, so one-deep
+        # pipelining caps throughput at ~1 chunk per RTT; depth D keeps
+        # D fetches in flight (each pending chunk holds only a packed
+        # [B, 2k] top-k buffer)
+        self.pipeline_depth = max(1, pipeline_depth)
 
     def _batch_cap(self, n: int) -> int:
         return min(self.query_batch, next_capacity(max(n, 1), 1))
@@ -138,14 +168,12 @@ class Searcher(QueryVectorizerMixin):
                 out.extend(self._search_unbounded(snap, chunk))
             global_metrics.inc("queries_served", len(queries))
             return out
-        pending = None                 # (chunk, packed device array, kk)
-        for lo in range(0, len(queries), cap):
-            chunk = queries[lo:lo + cap]
-            dispatched = self._dispatch_chunk(snap, chunk, k)
-            if pending is not None:
-                out.extend(self._finish_chunk(snap, *pending))
-            pending = (chunk,) + dispatched
-        out.extend(self._finish_chunk(snap, *pending))
+        out.extend(self._run_pipelined(
+            (queries[lo:lo + cap]
+             for lo in range(0, len(queries), cap)),
+            lambda chunk: (chunk,) + self._dispatch_chunk(snap, chunk,
+                                                          k),
+            lambda *state: self._finish_chunk(snap, *state)))
         global_metrics.inc("queries_served", len(queries))
         return out
 

@@ -690,13 +690,18 @@ class SegmentedIndex:
 
     def wait_for_merges(self, timeout: float | None = None) -> None:
         """Block until every in-flight background merge has spliced
-        (test and shutdown hook)."""
+        (test and shutdown hook). ``timeout`` bounds the WHOLE wait —
+        one shared deadline, not one timeout per discovered future."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         while True:
             with self._write_lock:
                 fut = next(iter(self._merge_futs.values()), None)
             if fut is None:
                 return
-            fut.result(timeout=timeout)
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            fut.result(timeout=remaining)
 
     def doc_name(self, gid: int) -> str:
         assert self.snapshot is not None

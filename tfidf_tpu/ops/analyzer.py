@@ -189,15 +189,18 @@ def _parse_tounicode(cmap_bytes: bytes) -> tuple[dict[int, str], int]:
 
 
 def _collect_tounicode(data: bytes, streams: list[bytes]
-                       ) -> tuple[dict[int, str], int]:
-    """Union of every ToUnicode CMap in the document.
+                       ) -> dict[int, dict[int, str]]:
+    """Every ToUnicode CMap in the document, merged PER CODE WIDTH:
+    ``{code_byte_length: {code: text}}``.
 
     Per-font tracking (following ``Tf`` operators) is what Tika does;
-    merging all maps covers the dominant single-embedded-font case and
-    disjoint CID spaces, and a collision merely swaps glyphs of the
-    same document's fonts — acceptable for search-text extraction."""
-    merged: dict[int, str] = {}
-    code_len = 2
+    merging same-width maps covers the dominant single-embedded-font
+    case and disjoint CID spaces, and a collision merely swaps glyphs
+    of the same document's fonts — acceptable for search-text
+    extraction. Widths stay separate: letting a 1-byte simple-font
+    CMap override the code length of a 2-byte CID font would split its
+    show strings into bytes and decode wrong text."""
+    merged: dict[int, dict[int, str]] = {}
     # streams referenced as "/ToUnicode N 0 R": resolve object N, else
     # fall back to any stream that contains CMap markers
     ref_objs = set(re.findall(rb"/ToUnicode\s+(\d+)\s+0\s+R", data))
@@ -231,27 +234,30 @@ def _collect_tounicode(data: bytes, streams: list[bytes]
             continue
         cmap, cl = _parse_tounicode(raw)
         if cmap:
-            merged.update(cmap)
-            code_len = cl
-    return merged, code_len
+            merged.setdefault(cl, {}).update(cmap)
+    return merged
 
 
-def _decode_cids(raw: bytes, cmap: dict[int, str], code_len: int
+def _decode_cids(raw: bytes, cmaps: dict[int, dict[int, str]]
                  ) -> str | None:
-    """Decode show-string bytes as CID codes through the ToUnicode map.
-    Returns None unless most codes map — emitting unmapped glyph ids
-    would index noise."""
-    if not cmap or not raw:
+    """Decode show-string bytes as CID codes through the ToUnicode
+    maps, trying each code width (widest first — a 2-byte string rarely
+    decodes >=80% through a 1-byte map by accident, but prefer the
+    stricter interpretation). Returns None unless most codes map —
+    emitting unmapped glyph ids would index noise."""
+    if not cmaps or not raw:
         return None
-    n = len(raw) // code_len
-    if n == 0:
-        return None
-    codes = [int.from_bytes(raw[i * code_len:(i + 1) * code_len], "big")
-             for i in range(n)]
-    hits = [cmap[c] for c in codes if c in cmap]
-    if len(hits) < max(1, int(0.8 * n)):
-        return None
-    return "".join(hits)
+    for code_len in sorted(cmaps, reverse=True):
+        cmap = cmaps[code_len]
+        n = len(raw) // code_len
+        if n == 0:
+            continue
+        codes = [int.from_bytes(raw[i * code_len:(i + 1) * code_len],
+                                "big") for i in range(n)]
+        hits = [cmap[c] for c in codes if c in cmap]
+        if len(hits) >= max(1, int(0.8 * n)):
+            return "".join(hits)
+    return None
 
 
 def _extract_pdf(data: bytes) -> str:
@@ -267,10 +273,10 @@ def _extract_pdf(data: bytes) -> str:
     streams: list[bytes] = [
         m.group(1) for m in re.finditer(rb"stream\r?\n(.*?)endstream",
                                         data, re.S)]
-    cmap, code_len = _collect_tounicode(data, streams)
+    cmaps = _collect_tounicode(data, streams)
 
     def show(raw_bytes: bytes) -> str:
-        cid = _decode_cids(raw_bytes, cmap, code_len)
+        cid = _decode_cids(raw_bytes, cmaps)
         if cid is not None:
             return cid
         return raw_bytes.decode("latin-1")
@@ -288,8 +294,7 @@ def _extract_pdf(data: bytes) -> str:
         for t in re.finditer(rb"<([0-9A-Fa-f\s]+)>\s*Tj", raw):
             h = re.sub(rb"\s", rb"", t.group(1)).decode()
             decoded = _decode_cids(
-                bytes.fromhex(h if len(h) % 2 == 0 else h + "0"),
-                cmap, code_len)
+                bytes.fromhex(h if len(h) % 2 == 0 else h + "0"), cmaps)
             if decoded is not None:
                 texts.append(decoded)
         for arr in re.finditer(rb"\[((?:\\.|<[^>]*>|[^\]])*)\]\s*TJ",
@@ -301,7 +306,7 @@ def _extract_pdf(data: bytes) -> str:
                 h = re.sub(rb"\s", rb"", t.group(1)).decode()
                 decoded = _decode_cids(
                     bytes.fromhex(h if len(h) % 2 == 0 else h + "0"),
-                    cmap, code_len)
+                    cmaps)
                 if decoded is not None:
                     texts.append(decoded)
     return " ".join(texts)

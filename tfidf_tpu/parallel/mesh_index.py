@@ -347,7 +347,8 @@ class MeshSearcher(QueryVectorizerMixin):
                  model: ScoringModel,
                  *, query_batch: int = 32, max_query_terms: int = 32,
                  top_k: int = 10, result_order: str = "score",
-                 global_idf: bool = True) -> None:
+                 global_idf: bool = True,
+                 pipeline_depth: int = 2) -> None:
         self.index = index
         self.analyzer = analyzer
         self.vocab = vocab
@@ -356,6 +357,7 @@ class MeshSearcher(QueryVectorizerMixin):
         self.max_query_terms = max_query_terms
         self.top_k = top_k
         self.result_order = result_order
+        self.pipeline_depth = max(1, pipeline_depth)
         # global_idf=False reproduces the reference's per-worker statistics
         # (each Lucene shard scores against local df/N, Worker.java:222-241)
         self.global_idf = global_idf
@@ -391,10 +393,11 @@ class MeshSearcher(QueryVectorizerMixin):
 
     def search(self, queries: list[str], k: int | None = None,
                *, unbounded: bool = False):
-        """Chunks are pipelined one deep, as in
-        :meth:`tfidf_tpu.engine.searcher.Searcher.search`: the next
-        chunk's shard_map program is dispatched before the previous
-        chunk's packed top-k is fetched, hiding the device->host RTT."""
+        """Chunks are pipelined ``pipeline_depth`` deep, as in
+        :meth:`tfidf_tpu.engine.searcher.Searcher.search`: later chunks'
+        shard_map programs are dispatched before earlier chunks' packed
+        top-k buffers are fetched, hiding the device->host RTT (which
+        dominates device compute on small corpora)."""
         snap = self.index.snapshot
         self._on_snapshot(snap)
         if snap is None or snap.total_live == 0 or not queries:
@@ -402,18 +405,18 @@ class MeshSearcher(QueryVectorizerMixin):
         if unbounded:
             return self._search_unbounded(snap, queries, k)
         k = self.top_k if k is None else k
-        out = []
         cap = self._batch_cap(len(queries))
-        pending = None              # (chunk, packed device array, kk)
-        for lo in range(0, len(queries), cap):
-            chunk = queries[lo:lo + cap]
+
+        def dispatch(chunk):
             qb, _widest = self._vectorize(chunk,
                                           self._batch_cap(len(chunk)))
-            dispatched = self._dispatch_chunk(snap, qb, k)
-            if pending is not None:
-                out.extend(self._finish_chunk(snap, *pending))
-            pending = (chunk,) + dispatched
-        out.extend(self._finish_chunk(snap, *pending))
+            return (chunk,) + self._dispatch_chunk(snap, qb, k)
+
+        out = self._run_pipelined(
+            (queries[lo:lo + cap]
+             for lo in range(0, len(queries), cap)),
+            dispatch,
+            lambda *state: self._finish_chunk(snap, *state))
         global_metrics.inc("queries_served", len(queries))
         return out
 

@@ -97,6 +97,11 @@ class Config:
     dist_process_id: int = -1          # -1 = auto-detect
     query_batch: int = 32              # padded query batch per scoring step
     max_query_terms: int = 32          # padded terms per query
+    # In-flight query chunks inside one search_batch call. On small
+    # corpora the device step is much shorter than the device->host
+    # fetch RTT; depth 2 overlaps one fetch with the next chunk's
+    # compute (measured best — deeper only queues serial fetches).
+    search_pipeline_depth: int = 2
 
     # --- capacity bucketing (static shapes for XLA) ---
     min_doc_capacity: int = 1024

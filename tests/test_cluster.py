@@ -641,3 +641,107 @@ class TestMeshCluster:
                     n.stop()
                 except Exception:
                     pass
+
+
+class TestScatterClient:
+    """_ScatterClient retry/pruning semantics (code-review r4)."""
+
+    def test_retries_stale_connection_not_timeout(self):
+        import http.server
+        import socket
+        import threading
+
+        from tfidf_tpu.cluster.node import _ScatterClient
+
+        hits = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                hits.append(self.path)
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = b"[]"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        c = _ScatterClient()
+        try:
+            assert c.post(base, "/worker/process", b"{}") == b"[]"
+            # server restarts: the cached keep-alive connection is stale;
+            # ONE transparent retry on a fresh connection must succeed
+            srv.shutdown()
+            srv.server_close()
+            srv2 = http.server.ThreadingHTTPServer(
+                ("127.0.0.1", srv.server_address[1]), H)
+            threading.Thread(target=srv2.serve_forever,
+                             daemon=True).start()
+            assert c.post(base, "/worker/process", b"{}") == b"[]"
+            srv2.shutdown()
+            srv2.server_close()
+        finally:
+            pass
+        # a connection-refused endpoint exhausts the single retry and
+        # raises (never loops)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+        with pytest.raises(Exception):
+            c.post(dead, "/worker/process", b"{}")
+
+    def test_prunes_departed_workers(self):
+        from tfidf_tpu.cluster.node import _ScatterClient
+
+        c = _ScatterClient()
+        c._tls.conns = {"http://old:1": _FakeConn(),
+                        "http://live:2": _FakeConn()}
+        try:
+            c.post("http://live:2", "/x", b"", live={"http://live:2"})
+        except Exception:
+            pass   # the fake conn fails the request; pruning is the point
+        assert "http://old:1" not in c._tls.conns
+
+
+class _FakeConn:
+    closed = False
+
+    def close(self):
+        self.closed = True
+
+    def request(self, *a, **kw):
+        raise ConnectionResetError("fake")
+
+
+class TestSizeCacheEviction:
+    def test_stale_poll_cannot_resurrect_evicted_worker(self, cluster):
+        """A worker evicted from the size cache during a poll must not
+        re-enter it from that poll's pre-failure data (code-review r4)."""
+        import time as _time
+
+        leader = cluster[0]
+        workers = leader.registry.get_all_service_addresses()
+        w = workers[0]
+        with leader._placement_lock:
+            leader._size_cache = (0.0, {})   # force a fresh poll
+        leader._ensure_sizes_fresh(workers)
+        assert w in leader._size_cache[1]
+        # simulate a failure-eviction racing a poll that started earlier
+        with leader._placement_lock:
+            leader._size_cache[1].pop(w, None)
+            leader._evicted[w] = _time.monotonic() + 60.0   # "future"
+            leader._size_cache = (0.0, leader._size_cache[1])
+        leader._ensure_sizes_fresh(workers)
+        assert w not in leader._size_cache[1]
+        # once the eviction is old news, the next poll restores it
+        with leader._placement_lock:
+            leader._evicted[w] = _time.monotonic() - 1.0
+            leader._size_cache = (0.0, leader._size_cache[1])
+        leader._ensure_sizes_fresh(workers)
+        assert w in leader._size_cache[1]

@@ -149,12 +149,14 @@ class Searcher(QueryVectorizerMixin):
         ``Integer.MAX_VALUE`` behavior, ``Worker.java:230``) via a host-side
         full ranking — parity mode only; exact top-k is the fast path.
 
-        Chunks are PIPELINED one deep: chunk i+1's device program is
-        dispatched before chunk i's packed top-k is fetched, so the
-        device->host round trip and host-side hit assembly hide under the
-        next chunk's device time. On high-latency links (remote-TPU
-        tunnels, ~100ms RTT) this is the difference between
-        latency-bound and compute-bound throughput.
+        Chunks are PIPELINED ``pipeline_depth`` deep (default 2): later
+        chunks' device programs are dispatched before earlier chunks'
+        packed top-k buffers are fetched, so the device->host round trip
+        and host-side hit assembly hide under device time. On
+        high-latency links (remote-TPU tunnels, ~100ms RTT) this is the
+        difference between latency-bound and compute-bound throughput;
+        fetches serialize on one stream, so depth beyond 2 does not help
+        (PERF.md) — batch size is the throughput lever there.
         """
         snap = self.index.snapshot
         if snap is None or not snap.num_names or not queries:

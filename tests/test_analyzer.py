@@ -310,3 +310,105 @@ def test_extract_html():
     out = extract_text(html)
     assert "visible" in out and "<text>" in out
     assert "color" not in out and "var x" not in out
+
+
+def test_extract_rtf():
+    """RTF body text extracts; tables/metadata destinations are
+    dropped; \\uN unicode and \\'xx cp1252 escapes decode (Tika
+    RTFParser analog, Worker.java:198-212)."""
+    rtf = (rb"{\rtf1\ansi{\fonttbl{\f0 Times New Roman;}}"
+           rb"{\info{\author Secret Name}}"
+           rb"{\*\themedata deadbeef}"
+           rb"\f0\fs24 Plain rtf body text\par "
+           rb"with \'e9clair and \emdash dashes.\par}")
+    out = extract_text(rtf)
+    assert "Plain rtf body text" in out
+    assert "\xe9clair" in out            # \'e9 -> cp1252 e-acute
+    assert "—" in out               # \emdash
+    assert "Times" not in out          # fonttbl dropped
+    assert "Secret" not in out         # info dropped
+    assert "deadbeef" not in out       # \* optional destination dropped
+
+
+def test_extract_rtf_empty_rejected():
+    import pytest
+
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(rb"{\rtf1{\fonttbl{\f0 Arial;}}}")
+
+
+def test_extract_odt():
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    content = (b'<?xml version="1.0"?><office:document-content>'
+               b"<office:body><office:text>"
+               b"<text:p>Odt paragraph one</text:p>"
+               b"<text:p>And&amp;two<text:tab/>tabbed</text:p>"
+               b"</office:text></office:body>"
+               b"</office:document-content>")
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("mimetype", "application/vnd.oasis.opendocument.text")
+        z.writestr("content.xml", content)
+    out = extract_text(buf.getvalue())
+    assert "Odt paragraph one" in out
+    assert "And&two" in out and "tabbed" in out
+
+
+def test_zip_without_known_content_rejected():
+    import io
+    import zipfile
+
+    import pytest
+
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("random.bin", b"\x00" * 64)
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(buf.getvalue())
+
+
+def test_rtf_uc_skip_does_not_leak_from_skipped_group():
+    out = extract_text(rb"{\rtf1{\info\u233 e}body text}")
+    assert "body text" in out
+
+
+def test_rtf_surrogate_pairs_combine_lone_drop():
+    # Word writes non-BMP chars as surrogate-pair \uN escapes
+    out = extract_text(rb"{\rtf1 hi \u-10179 ?\u-9089 ? end}")
+    assert "\U0001f47f" in out          # combined astral char
+    out.encode("utf-8")                 # must be UTF-8-serializable
+    out2 = extract_text(rb"{\rtf1 lone \u-10179 ? end}")
+    assert "lone" in out2 and "end" in out2
+    out2.encode("utf-8")                # lone surrogate dropped
+
+
+def test_rtf_bin_payload_cannot_corrupt_group_stack():
+    payload = bytes([0x7D, 0x7B]) * 5   # braces inside raw binary
+    rtf = (rb"{\rtf1{\pict\bin10 " + payload
+           + rb"} visible body\par}")
+    out = extract_text(rtf)
+    assert "visible body" in out
+    assert "\x7d\x7b" not in out
+
+
+def test_empty_odt_rejected():
+    import io
+    import zipfile
+
+    import pytest
+
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("content.xml",
+                   b"<office:body><office:text></office:text>"
+                   b"</office:body>")
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(buf.getvalue())

@@ -12,10 +12,12 @@ library calls inside the worker:
   ("can't" is one token) and MidNum rule ("3.14" is one token).
 * Apache Tika ``AutoDetectParser`` — the reference's fallback for non-UTF-8
   bytes (``Worker.java:198-212``). Reproduced as magic-byte dispatch with
-  minimal pure-Python extractors (PDF ``Tj/TJ`` operators, DOCX
-  ``word/document.xml``, HTML tag stripping), charset fallback for plain
-  text, and a typed :class:`UnsupportedMediaType` rejection for binaries —
-  an upload is extracted or refused, never indexed as mojibake.
+  minimal pure-Python extractors (PDF ``Tj/TJ`` operators including
+  CID/ToUnicode-encoded text, DOCX ``word/document.xml``, ODT
+  ``content.xml``, RTF group-tree walking, HTML tag stripping), charset
+  fallback for plain text, and a typed :class:`UnsupportedMediaType`
+  rejection for binaries — an upload is extracted or refused, never
+  indexed as mojibake.
 
 The pure-Python tokenizer is the portable baseline implementation (a C++
 fast path for the ingest hot loop is planned under ``native/``).
@@ -325,6 +327,118 @@ def _extract_docx(data: bytes) -> str:
     return html.unescape(re.sub(r"<[^>]+>", " ", " ".join(parts)))
 
 
+def _extract_odt(data: bytes) -> str:
+    """OpenDocument Text = zip + ``content.xml``; body text lives in
+    ``<text:p>``/``<text:span>`` runs (Tika's ODF parser analog)."""
+    import html
+    import io
+    import zipfile
+
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        with z.open("content.xml") as f:
+            xml = f.read().decode("utf-8", "replace")
+    body = re.search(r"<office:body>(.*)</office:body>", xml, re.S)
+    xml = body.group(1) if body is not None else xml
+    # paragraph/tab/space elements carry whitespace semantics
+    xml = re.sub(r"<text:(?:line-break|tab)[^>]*/?>", " ", xml)
+    xml = re.sub(r"</text:[ph][^>]*>", "\n", xml)
+    return html.unescape(re.sub(r"<[^>]+>", " ", xml))
+
+
+# RTF control words with a direct text meaning
+_RTF_SPECIAL = {"par": "\n", "line": "\n", "sect": "\n", "page": "\n",
+                "tab": "\t", "emdash": "\u2014", "endash": "\u2013",
+                "lquote": "\u2018", "rquote": "\u2019",
+                "ldblquote": "\u201c", "rdblquote": "\u201d",
+                "bullet": "\u2022", "emspace": " ", "enspace": " "}
+# destination groups whose content is metadata/resources, not body text
+_RTF_SKIP_DESTS = frozenset((
+    "fonttbl", "colortbl", "stylesheet", "info", "pict", "object",
+    "header", "footer", "headerl", "headerr", "footerl", "footerr",
+    "ftnsep", "xe", "tc", "fldinst", "themedata", "datastore"))
+_RTF_TOKEN = re.compile(
+    r"\\([a-z]{1,32})(-?\d{1,10})? ?|\\'([0-9a-fA-F]{2})"
+    r"|\\([^a-z])|([{}])|([^\\{}]+)", re.S)
+
+
+def _rtf_strip_bin(text: str) -> str:
+    """Remove ``\\binN`` runs WITH their N raw payload bytes before
+    tokenizing: brace bytes inside a binary payload would otherwise
+    corrupt the group stack (and the payload would index as noise)."""
+    out: list[str] = []
+    i = 0
+    for m in re.finditer(r"\\bin(\d+) ?", text):
+        if m.start() < i:
+            continue   # a "\binN" inside another bin's payload
+        out.append(text[i:m.start()])
+        i = m.end() + int(m.group(1))
+    out.append(text[i:])
+    return "".join(out)
+
+
+def _extract_rtf(data: bytes) -> str:
+    """Minimal RTF body-text pull (Tika's RTFParser analog): walks the
+    group tree, drops resource/metadata destinations and ``\\binN``
+    payloads, honors ``\\uN`` unicode escapes (surrogate pairs
+    combined, lone surrogates dropped), ``\\'xx`` cp1252 bytes, and
+    paragraph controls."""
+    text = _rtf_strip_bin(data.decode("latin-1", "replace"))
+    out: list[str] = []
+    stack: list[int] = []
+    skip = 0
+    uc_skip = 0   # chars to swallow after \uN (the ANSI fallback)
+    for m in _RTF_TOKEN.finditer(text):
+        word, arg, hexb, esc, brace, plain = m.groups()
+        if brace == "{":
+            stack.append(skip)
+            continue
+        if brace == "}":
+            skip = stack.pop() if stack else 0
+            uc_skip = 0   # a fallback never spans a group boundary
+            continue
+        if esc is not None:
+            if esc == "*":        # \* introduces an optional destination
+                skip = 1
+            elif not skip and esc in "\\{}":
+                out.append(esc)
+            elif not skip and esc == "~":
+                out.append("\u00a0")
+            continue
+        if hexb is not None:
+            if uc_skip:
+                uc_skip -= 1
+            elif not skip:
+                out.append(bytes([int(hexb, 16)])
+                           .decode("cp1252", "replace"))
+            continue
+        if word is not None:
+            if word in _RTF_SKIP_DESTS:
+                skip = 1
+            elif word == "u" and arg is not None:
+                # only arm the fallback-swallow OUTSIDE skipped groups:
+                # a skipped group's fallback char is skipped with the
+                # group, and a leaked uc_skip would eat the first body
+                # character after it
+                if not skip:
+                    out.append(chr(int(arg) & 0xFFFF))
+                    uc_skip = 1
+            elif word in _RTF_SPECIAL and not skip:
+                out.append(_RTF_SPECIAL[word])
+            continue
+        if plain and not skip:
+            if uc_skip:
+                plain = plain[uc_skip:]
+                uc_skip = 0
+            out.append(plain)
+    joined = "".join(out).replace("\r\n", "\n")
+    # \uN surrogate-pair escapes (Word writes non-BMP chars this way):
+    # the utf-16 round trip combines adjacent pairs into real astral
+    # chars and drops lone surrogates, which cannot be UTF-8 encoded
+    # and would crash any downstream serialization
+    return (joined.encode("utf-16-le", "surrogatepass")
+            .decode("utf-16-le", "ignore"))
+
+
 def _extract_html(text: str) -> str:
     """Strip tags/scripts/styles, unescape entities."""
     import html
@@ -343,11 +457,12 @@ _BINARY_MAGICS = (b"\x7fELF", b"\x89PNG", b"\xff\xd8\xff", b"GIF8",
 def extract_text(data: bytes) -> str:
     """Bytes -> searchable text, the Tika-parity dispatch.
 
-    Known document formats are extracted (PDF, DOCX, HTML); plain text
-    goes through charset fallback (UTF-8 strict first, like
-    ``Files.readString``, then BOM'd UTF-16, then Latin-1); recognized
-    binaries and undecodable blobs raise :class:`UnsupportedMediaType`
-    instead of entering the index as noise.
+    Known document formats are extracted (PDF including CID/ToUnicode
+    text, DOCX, ODT, RTF, HTML); plain text goes through charset
+    fallback (UTF-8 strict first, like ``Files.readString``, then BOM'd
+    UTF-16, then Latin-1); recognized binaries, undecodable blobs, and
+    text-free documents raise :class:`UnsupportedMediaType` instead of
+    entering the index as noise.
     """
     if data[:5] == b"%PDF-":
         text = _extract_pdf(data)
@@ -355,12 +470,26 @@ def extract_text(data: bytes) -> str:
             raise UnsupportedMediaType(
                 "PDF with no extractable text (unsupported encoding)")
         return text
+    if data[:5] == b"{\\rtf":
+        text = _extract_rtf(data)
+        if not text.strip():
+            raise UnsupportedMediaType("RTF with no extractable text")
+        return text
     if data[:4] == b"PK\x03\x04":
+        text = None
         try:
-            return _extract_docx(data)
+            text = _extract_docx(data)
         except Exception:
+            try:
+                text = _extract_odt(data)
+            except Exception:
+                raise UnsupportedMediaType(
+                    "zip container without word/document.xml or "
+                    "ODF content.xml")
+        if not text.strip():
             raise UnsupportedMediaType(
-                "zip container without word/document.xml")
+                "document container with no extractable text")
+        return text
     for magic in _BINARY_MAGICS:
         if data[:len(magic)] == magic:
             raise UnsupportedMediaType(

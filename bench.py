@@ -543,6 +543,7 @@ class _KeepAlive:
              timeout: float = 600.0) -> bytes:
         import http.client
         key = f"conn_{hostport[1]}"
+        last: Exception | None = None
         for _ in range(2):
             c = getattr(self._tls, key, None)
             if c is None:
@@ -552,10 +553,13 @@ class _KeepAlive:
                 c.request("POST", path, body=data, headers={
                     "Content-Type": "application/octet-stream"})
                 return c.getresponse().read()
-            except Exception:
+            except Exception as e:
+                last = e
                 c.close()
                 setattr(self._tls, key, None)
-        raise RuntimeError("post failed")
+        # keep the cause: a timeout, a reset, and an HTTP error need
+        # different fixes, and a bare "post failed" hides which happened
+        raise RuntimeError(f"post {path} failed") from last
 
 
 def _kill_all(procs) -> None:
@@ -802,23 +806,42 @@ def bench_realistic(rng) -> dict:
 
 C2T_DOCS = 100_000
 C2T_TPU_SHARE = 95_000
-C2T_VOCAB = 200_000
 C2T_AVG_LEN = 80
-C2T_CLIENTS = 128
-C2T_QUERIES = 2048
-C2T_QUERY_BATCH = 128
+C2T_CLIENTS = 512
+C2T_QUERIES = 8192
+C2T_QUERY_BATCH = 128      # worker-side engine chunk (pipelines inside)
+C2T_SCATTER_BATCH = 256    # leader-side coalesced scatter group
 C2T_LINGER_MS = 5.0
+C2T_PARITY_QUERIES = 32
+
+
+def _delta_timing(m0: dict, m1: dict, name: str) -> float:
+    """Windowed mean (ms) of a Metrics timing between two snapshots."""
+    n = m1.get(f"{name}_count", 0) - m0.get(f"{name}_count", 0)
+    s = m1.get(f"{name}_sum_ms", 0.0) - m0.get(f"{name}_sum_ms", 0.0)
+    return round(s / n, 3) if n else 0.0
 
 
 def bench_cluster_tpu(rng) -> dict:
     """The distributed HTTP serving path against a TPU-backed engine —
     the reference's only serving shape (``Leader.java:39-92``) with the
-    TPU doing the scoring. The axon tunnel admits ONE TPU client, so the
-    topology is: leader (CPU, scatter-gather only) + worker0 (TPU,
-    ~95% of the corpus) + worker1 (CPU, the tail). The phased upload
-    (worker0 alone first, then worker1 joins and takes the remainder via
-    least-loaded placement) both skews the corpus onto the TPU worker
-    and exercises elastic join (SURVEY §5.3).
+    TPU doing the scoring, driven with REALISTIC text (the reference's
+    workload is real files through a real analyzer, Worker.java:125-146):
+    the textgen corpus (plain/HTML/latin-1 + a binary fraction that must
+    415). The axon tunnel admits ONE TPU client, so the topology is:
+    leader (CPU, scatter-gather only) + worker0 (TPU, ~95% of the
+    corpus) + worker1 (CPU, the tail). The phased upload (worker0 alone
+    first, then worker1 joins and takes the remainder via least-loaded
+    placement) both skews the corpus onto the TPU worker and exercises
+    elastic join (SURVEY §5.3).
+
+    Serving runs the round-5 batched scatter: concurrent /leader/start
+    queries coalesce into one packed-binary RPC per worker. The config
+    reports a per-stage breakdown (linger/RPC/decode/merge at the
+    leader, search/pack at the TPU worker) from windowed /api/metrics
+    deltas, and a parity gate: /leader/start must equal the sum-merged
+    union of direct per-worker /worker/process results (the per-query
+    reference shape) for every parity query.
 
     MUST run before this process initializes jax: the TPU worker
     subprocess has to be the tunnel's only TPU client."""
@@ -828,13 +851,27 @@ def bench_cluster_tpu(rng) -> dict:
     import subprocess
     import tempfile
 
+    from tfidf_tpu.utils.textgen import RealisticCorpus, harvest_lexicon
+
     client = _KeepAlive()
     post = client.post
 
     t0 = time.perf_counter()
-    texts = make_texts(rng, C2T_DOCS, C2T_VOCAB, C2T_AVG_LEN)
-    queries = make_queries(rng, C2T_VOCAB, 3 * C2T_QUERIES)
-    log(f"[c2t] corpus in {time.perf_counter()-t0:.0f}s")
+    words, _ = harvest_lexicon()
+    gen = RealisticCorpus(rng, words)
+    payloads = [gen.make_payload(C2T_AVG_LEN) for _ in range(C2T_DOCS)]
+    kinds: dict[str, int] = {}
+    for _p, k in payloads:
+        kinds[k] = kinds.get(k, 0) + 1
+
+    def make_query() -> str:
+        k = int(rng.integers(2, 5))
+        idx = rng.choice(len(words), size=k, p=gen.p)
+        return " ".join(words[i] for i in idx)
+
+    queries = [make_query() for _ in range(3 * C2T_QUERIES)]
+    log(f"[c2t] {C2T_DOCS} realistic docs ({kinds}) in "
+        f"{time.perf_counter()-t0:.0f}s")
 
     cpu_env = dict(os.environ, TFIDF_JAX_PLATFORM="cpu",
                    JAX_PLATFORMS="cpu")
@@ -845,7 +882,9 @@ def bench_cluster_tpu(rng) -> dict:
     for e in (cpu_env, tpu_env):
         e["TFIDF_QUERY_BATCH"] = str(C2T_QUERY_BATCH)
         e["TFIDF_BATCH_LINGER_MS"] = str(C2T_LINGER_MS)
-        e["TFIDF_FANOUT_WORKERS"] = str(2 * C2T_CLIENTS)
+        e["TFIDF_SCATTER_BATCH"] = str(C2T_SCATTER_BATCH)
+        e["TFIDF_SCATTER_PIPELINE"] = "4"
+        e["TFIDF_FANOUT_WORKERS"] = "32"
 
     procs = []
     tmp = tempfile.mkdtemp(prefix="bench_c2t_")
@@ -880,53 +919,95 @@ def bench_cluster_tpu(rng) -> dict:
                     == [urls[1]])
 
         leader_hp = ("127.0.0.1", ports[0])
-        groups = [[{"name": f"d{i}.txt", "text": texts[i]}
-                   for i in range(lo, min(lo + 500, C2T_TPU_SHARE))]
-                  for lo in range(0, C2T_TPU_SHARE, 500)]
+        rejected = 0
+
+        def upload_range(lo: int, hi: int) -> int:
+            """Upload docs [lo, hi): UTF-8 text in bulk batches, the
+            rest (latin-1/binary) through the per-file endpoint, like a
+            mixed real-world client. Returns the 415 count."""
+            batch: list[dict] = []
+            singles: list[tuple[str, bytes]] = []
+            for i in range(lo, hi):
+                data, kind = payloads[i]
+                name = f"d{i}.txt"
+                if kind != "binary":
+                    try:
+                        batch.append({"name": name,
+                                      "text": data.decode("utf-8")})
+                        continue
+                    except UnicodeDecodeError:
+                        pass
+                singles.append((name, data))
+            groups = [batch[g:g + 500] for g in range(0, len(batch), 500)]
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                list(ex.map(lambda g: post(
+                    leader_hp, "/leader/upload-batch",
+                    _json.dumps(g).encode()), groups))
+                n415 = sum(ex.map(
+                    lambda nd: int(b"unsupported media type" in post(
+                        leader_hp, f"/leader/upload?name={nd[0]}",
+                        nd[1])), singles))
+            return n415
+
         t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(8) as ex:
-            list(ex.map(lambda g: post(
-                leader_hp, "/leader/upload-batch",
-                _json.dumps(g).encode()), groups))
+        rejected += upload_range(0, C2T_TPU_SHARE)
         up1_s = time.perf_counter() - t0
         log(f"[c2t] {C2T_TPU_SHARE} docs -> TPU worker in {up1_s:.0f}s "
-            f"({C2T_TPU_SHARE/up1_s:.0f} docs/s)")
+            f"({C2T_TPU_SHARE/up1_s:.0f} docs/s), {rejected} binary 415s")
 
         spawn(node_args(2), cpu_env)   # CPU worker joins late
         _wait_until(lambda: len(_json.loads(
             _http_get(urls[0] + "/api/services"))) == 2)
-        tail = [[{"name": f"d{i}.txt", "text": texts[i]}
-                 for i in range(lo, min(lo + 500, C2T_DOCS))]
-                for lo in range(C2T_TPU_SHARE, C2T_DOCS, 500)]
-        with concurrent.futures.ThreadPoolExecutor(8) as ex:
-            list(ex.map(lambda g: post(
-                leader_hp, "/leader/upload-batch",
-                _json.dumps(g).encode()), tail))
+        rejected += upload_range(C2T_TPU_SHARE, C2T_DOCS)
+        assert rejected == kinds.get("binary", 0), \
+            (rejected, kinds.get("binary", 0))
 
         # force each worker's NRT commit + first compile directly: the
         # leader's scatter RPC timeout is 10s, a cold commit is not
         for i in (1, 2):
             t0 = time.perf_counter()
             post(("127.0.0.1", ports[i]), "/worker/process",
-                 b'{"query": "t0 t1"}', timeout=900.0)
+                 _json.dumps({"query": queries[0]}).encode(),
+                 timeout=900.0)
             log(f"[c2t] worker {i-1} cold commit+compile: "
                 f"{time.perf_counter()-t0:.0f}s")
 
         def start(q):
             return post(leader_hp, "/leader/start", q.encode())
 
-        for r in range(2):   # warm: compiles the micro-batch buckets
+        for r in range(2):   # warm: compiles the batch buckets
             with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
                 list(ex.map(start,
                             queries[r*C2T_QUERIES:(r+1)*C2T_QUERIES]))
-        m0 = _json.loads(_http_get(urls[1] + "/api/metrics"))
+        ml0 = _json.loads(_http_get(urls[0] + "/api/metrics"))
+        mw0 = _json.loads(_http_get(urls[1] + "/api/metrics"))
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(C2T_CLIENTS) as ex:
             res = list(ex.map(start,
                               queries[2*C2T_QUERIES:3*C2T_QUERIES]))
         qps = C2T_QUERIES / (time.perf_counter() - t0)
-        m1 = _json.loads(_http_get(urls[1] + "/api/metrics"))
-        assert all(_json.loads(r) for r in res[:32]), "empty results"
+        ml1 = _json.loads(_http_get(urls[0] + "/api/metrics"))
+        mw1 = _json.loads(_http_get(urls[1] + "/api/metrics"))
+        assert sum(bool(_json.loads(r)) for r in res[:64]) >= 32, \
+            "mostly-empty results"
+
+        # per-stage breakdown of one served query (VERDICT r4 #1):
+        # leader linger/RPC/decode/merge from the leader process, batch
+        # search/pack from the TPU worker, all windowed over the timed run
+        n_sb = (ml1.get("scatter_batches", 0)
+                - ml0.get("scatter_batches", 0))
+        n_si = (ml1.get("scatter_items", 0) - ml0.get("scatter_items", 0))
+        breakdown = {
+            "mean_scatter_batch": round(n_si / max(n_sb, 1), 1),
+            "leader_linger_ms": _delta_timing(ml0, ml1, "scatter_linger"),
+            "leader_rpc_ms": _delta_timing(ml0, ml1, "scatter_rpc"),
+            "leader_decode_ms": _delta_timing(ml0, ml1, "scatter_decode"),
+            "leader_merge_ms": _delta_timing(ml0, ml1, "scatter_merge"),
+            "worker_search_ms": _delta_timing(mw0, mw1,
+                                              "worker_batch_search"),
+            "worker_pack_ms": _delta_timing(mw0, mw1, "worker_batch_pack"),
+        }
+        log(f"[c2t] breakdown: {breakdown}")
 
         lat = []
         for q in queries[:32]:
@@ -934,7 +1015,29 @@ def bench_cluster_tpu(rng) -> dict:
             start(q)
             lat.append((time.perf_counter() - t0) * 1e3)
 
+        # parity gate: the batched scatter path must equal the sum-merged
+        # union of the per-query reference shape, worker by worker
+        for q in queries[:C2T_PARITY_QUERIES]:
+            merged: dict[str, float] = {}
+            for i in (1, 2):
+                hits = _json.loads(post(("127.0.0.1", ports[i]),
+                                        "/worker/process",
+                                        _json.dumps({"query": q}).encode()))
+                for h in hits:
+                    nm = h["document"]["name"]
+                    merged[nm] = merged.get(nm, 0.0) + float(h["score"])
+            want = dict(sorted(merged.items(),
+                               key=lambda kv: (-kv[1], kv[0]))[:TOP_K])
+            have = _json.loads(start(q))
+            assert list(have) == list(want), (q, have, want)
+            for nm in want:
+                np.testing.assert_allclose(have[nm], want[nm], rtol=1e-5,
+                                           err_msg=f"{q!r} {nm}")
+        log(f"[c2t] leader-vs-direct merge parity OK on "
+            f"{C2T_PARITY_QUERIES} queries")
+
         # isolate the leader layer: same load straight at the TPU worker
+        # through the reference-shaped per-query endpoint
         tpu_hp = ("127.0.0.1", ports[1])
 
         def direct(q):
@@ -947,23 +1050,22 @@ def bench_cluster_tpu(rng) -> dict:
             list(ex.map(direct, queries[C2T_QUERIES:2 * C2T_QUERIES]))
         direct_qps = C2T_QUERIES / (time.perf_counter() - t0)
 
-        served = (m1.get("queries_served", 0)
-                  - m0.get("queries_served", 0))
-        batches = (m1.get("query_batches", 0)
-                   - m0.get("query_batches", 0))
-        mean_batch = served / max(batches, 1)
         lat_ms = float(np.median(lat))
-        log(f"[c2t] /leader/start: {qps:.1f} q/s ({C2T_CLIENTS} clients,"
-            f" TPU mean batch {mean_batch:.1f}); direct worker "
-            f"{direct_qps:.1f} q/s; lone-query {lat_ms:.0f}ms")
+        log(f"[c2t] /leader/start: {qps:.1f} q/s ({C2T_CLIENTS} clients, "
+            f"mean scatter batch {breakdown['mean_scatter_batch']}); "
+            f"direct per-query worker {direct_qps:.1f} q/s; "
+            f"lone-query {lat_ms:.0f}ms")
         return {"qps": round(qps, 1),
                 "direct_worker_qps": round(direct_qps, 1),
                 "latency_ms": round(lat_ms, 1),
                 "upload_dps_tpu": round(C2T_TPU_SHARE / up1_s, 1),
                 "n_docs": C2T_DOCS, "tpu_share": C2T_TPU_SHARE,
                 "clients": C2T_CLIENTS,
-                "tpu_mean_batch": round(mean_batch, 1),
-                "workers": 2, "backend": "tpu worker + cpu worker"}
+                "kinds": kinds, "binary_rejected_415": rejected,
+                "breakdown": breakdown,
+                "parity_checked": True,
+                "workers": 2,
+                "backend": "tpu worker + cpu worker, realistic text"}
     finally:
         _kill_all(procs)
 

@@ -18,6 +18,7 @@ queries cost one kernel launch instead of B.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 
 from tfidf_tpu.utils.logging import get_logger
@@ -27,58 +28,56 @@ log = get_logger("cluster.batcher")
 
 
 class _Waiter:
-    __slots__ = ("query", "k", "unbounded", "event", "result", "error")
+    __slots__ = ("query", "event", "result", "error", "t0")
 
-    def __init__(self, query: str, k: int | None, unbounded: bool) -> None:
-        self.query = query
-        self.k = k
-        self.unbounded = unbounded
+    def __init__(self, query) -> None:
+        self.query = query   # the submitted item (any shape)
         self.event = threading.Event()
         self.result = None
         self.error: BaseException | None = None
+        self.t0 = 0.0   # submit time (linger accounting)
 
 
-class QueryBatcher:
-    """Coalesce concurrent search calls into device-sized batches.
+class Coalescer:
+    """Generic request coalescer: concurrent ``submit(item)`` calls group
+    into batches handed to ``batch_fn(items) -> results`` (positional,
+    same length). The leader's scatter path uses this to turn N
+    concurrent ``/leader/start`` requests into ONE batched RPC per
+    worker; the per-item linger wait is recorded as the
+    ``{name}_linger`` timing so the serving-path breakdown can attribute
+    queueing delay separately from RPC time.
 
-    Thread-safe; callers block until their query's results are ready.
-    Queries with differing (k, unbounded) parameters are grouped into
-    separate batches (they need different post-processing), preserving
-    arrival order within the queue.
-    """
+    ``pipeline`` dispatcher threads let one batch's RPC round trip
+    overlap the next batch's formation."""
 
-    def __init__(self, engine, max_batch: int = 32,
-                 linger_s: float = 0.002, pipeline: int = 1) -> None:
-        """``pipeline`` scorer threads run concurrent ``search_batch``
-        calls (the engine is a pure function of its snapshot, so this is
-        safe). On a high-RTT device link (remote-TPU tunnel) a second
-        in-flight batch hides one batch's result fetch under the next
-        batch's device compute — the same trick Searcher.search plays
-        across chunks, applied across micro-batches."""
-        self.engine = engine
+    def __init__(self, batch_fn, *, max_batch: int = 128,
+                 linger_s: float = 0.002, pipeline: int = 2,
+                 name: str = "coalesce", group_key=None) -> None:
+        """``group_key(item)``, when given, keeps a batch homogeneous:
+        only leading queued items sharing the head's key join it; the
+        rest stay queued in order for the next dispatcher round."""
+        self.batch_fn = batch_fn
         self.max_batch = max(1, max_batch)
         self.linger_s = linger_s
+        self.name = name
+        self.group_key = group_key
         self._lock = threading.Lock()
         self._items: deque[_Waiter] = deque()
         self._wake = threading.Event()
         self._stopping = False
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
-                             name=f"query-batcher-{i}")
+                             name=f"{name}-{i}")
             for i in range(max(1, pipeline))]
         for t in self._threads:
             t.start()
 
-    def search(self, query: str, k: int | None = None,
-               unbounded: bool = False):
-        """Submit one query; returns its hit list (blocking)."""
-        w = _Waiter(query, k, unbounded)
-        # check-and-enqueue under the lock: a check outside it could pass
-        # just before stop() drains the queue, leaving this waiter parked
-        # forever (ADVICE r2)
+    def submit(self, item):
+        w = _Waiter(item)
+        w.t0 = time.perf_counter()
         with self._lock:
             if self._stopping:
-                raise RuntimeError("batcher stopped")
+                raise RuntimeError(f"{self.name} stopped")
             self._items.append(w)
         self._wake.set()
         w.event.wait()
@@ -92,31 +91,48 @@ class QueryBatcher:
         self._wake.set()
         for t in self._threads:
             t.join(timeout=2.0)
-        # fail any stragglers rather than hanging their handler threads
         with self._lock:
             items, self._items = list(self._items), deque()
         for w in items:
-            w.error = RuntimeError("batcher stopped")
+            w.error = RuntimeError(f"{self.name} stopped")
             w.event.set()
-
-    # ---- batcher thread ----
 
     def _run(self) -> None:
         while True:
             self._wake.wait()
             if self._stopping:
                 return
-            # linger: give concurrent requests a moment to pile up so the
-            # device batch fills; a lone query pays at most linger_s
             if self.linger_s > 0:
-                threading.Event().wait(self.linger_s)
-            batch = self._take_batch()
+                # linger only while the batch could still fill: at
+                # saturation (a full batch already queued) the wait buys
+                # nothing and would tax every query's latency
+                with self._lock:
+                    full = len(self._items) >= self.max_batch
+                if not full:
+                    threading.Event().wait(self.linger_s)
+            with self._lock:
+                batch = []
+                if self._items:
+                    first = self._items.popleft()
+                    batch.append(first)
+                    key = (self.group_key(first.query)
+                           if self.group_key else None)
+                    while (self._items and len(batch) < self.max_batch
+                           and (self.group_key is None
+                                or self.group_key(self._items[0].query)
+                                == key)):
+                        batch.append(self._items.popleft())
+                if not self._items and not self._stopping:
+                    # never clear after stop() set the event, or sibling
+                    # dispatcher threads park in _wake.wait() forever
+                    self._wake.clear()
             if not batch:
                 continue
+            t0 = time.perf_counter()
+            for w in batch:   # queueing delay, attributed separately
+                global_metrics.observe(f"{self.name}_linger", t0 - w.t0)
             try:
-                results = self.engine.search_batch(
-                    [w.query for w in batch],
-                    k=batch[0].k, unbounded=batch[0].unbounded)
+                results = self.batch_fn([w.query for w in batch])
                 for w, r in zip(batch, results):
                     w.result = r
             except Exception as e:
@@ -124,28 +140,44 @@ class QueryBatcher:
                     w.error = e
             for w in batch:
                 w.event.set()
-            global_metrics.inc("query_batches")
-            global_metrics.set_gauge("last_query_batch_size", len(batch))
+            global_metrics.observe(f"{self.name}_batch_total",
+                                   time.perf_counter() - t0)
+            global_metrics.inc(f"{self.name}_batches")
+            global_metrics.inc(f"{self.name}_items", len(batch))
+            global_metrics.set_gauge(f"last_{self.name}_batch_size",
+                                     len(batch))
 
-    def _take_batch(self) -> list[_Waiter]:
-        """Pop the head group: leading queued items sharing the head's
-        (k, unbounded), up to max_batch. Items with other parameters stay
-        queued in order for the next round."""
-        with self._lock:
-            if not self._items:
-                if not self._stopping:
-                    # never clear after stop() set the event, or sibling
-                    # pipeline threads park in _wake.wait() forever
-                    self._wake.clear()
-                return []
-            first = self._items.popleft()
-            batch = [first]
-            while (self._items and len(batch) < self.max_batch
-                   and (self._items[0].k, self._items[0].unbounded)
-                   == (first.k, first.unbounded)):
-                batch.append(self._items.popleft())
-            if not self._items and not self._stopping:
-                # never clear after stop() set the event, or sibling
-                # pipeline threads park in _wake.wait() forever
-                self._wake.clear()
-        return batch
+
+class QueryBatcher(Coalescer):
+    """Coalesce concurrent search calls into device-sized batches.
+
+    Thread-safe; callers block until their query's results are ready.
+    Queries with differing (k, unbounded) parameters are grouped into
+    separate batches (they need different post-processing), preserving
+    arrival order within the queue — the ``group_key`` hook of the
+    generic :class:`Coalescer` this is built on.
+
+    ``pipeline`` scorer threads run concurrent ``search_batch`` calls
+    (the engine is a pure function of its snapshot, so this is safe). On
+    a high-RTT device link (remote-TPU tunnel) a second in-flight batch
+    hides one batch's result fetch under the next batch's device
+    compute — the same trick Searcher.search plays across chunks,
+    applied across micro-batches."""
+
+    def __init__(self, engine, max_batch: int = 32,
+                 linger_s: float = 0.002, pipeline: int = 1) -> None:
+        self.engine = engine
+        super().__init__(
+            self._score, max_batch=max_batch, linger_s=linger_s,
+            pipeline=pipeline, name="query",
+            group_key=lambda item: (item[1], item[2]))
+
+    def _score(self, items: list[tuple]) -> list:
+        k, unbounded = items[0][1], items[0][2]
+        return self.engine.search_batch(
+            [it[0] for it in items], k=k, unbounded=unbounded)
+
+    def search(self, query: str, k: int | None = None,
+               unbounded: bool = False):
+        """Submit one query; returns its hit list (blocking)."""
+        return self.submit((query, k, unbounded))

@@ -43,7 +43,8 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from tfidf_tpu.cluster.batcher import QueryBatcher
+from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
+from tfidf_tpu.cluster.wire import pack_hit_lists, unpack_hit_lists
 from tfidf_tpu.cluster.election import LeaderElection
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
 from tfidf_tpu.engine.engine import Engine
@@ -73,7 +74,14 @@ class _ScatterClient:
     persistent connection per (thread, worker) amortizes it away. A
     dropped keep-alive connection is retried once on a fresh one; any
     non-2xx status raises (the caller already treats per-worker errors as
-    tolerated scatter failures)."""
+    tolerated scatter failures).
+
+    IDEMPOTENT RPCs ONLY: the stale-connection retry re-sends the whole
+    request, and the first attempt may already have reached (even been
+    processed by) the worker if the connection died after the body went
+    out. Search reads (``/worker/process``, ``/worker/process-batch``)
+    are safe; routing an upload through this client could double-apply
+    it — uploads go through :func:`http_post` (no retry) instead."""
 
     # failures that mean "the keep-alive connection went stale between
     # requests" — retried once on a fresh connection. Timeouts and other
@@ -102,6 +110,14 @@ class _ScatterClient:
         last: Exception | None = None
         for _ in range(2):
             c = conns.get(base)
+            if c is not None and c.timeout != timeout:
+                # connections cache per (thread, worker) but callers mix
+                # timeouts (10s per-query scatter vs scatter_timeout_s
+                # batched) — retune the live socket instead of silently
+                # keeping the first caller's timeout
+                c.timeout = timeout
+                if c.sock is not None:
+                    c.sock.settimeout(timeout)
             if c is None:
                 c = conns[base] = http.client.HTTPConnection(
                     u.hostname, u.port, timeout=timeout)
@@ -182,6 +198,18 @@ class SearchNode:
             linger_s=self.config.batch_linger_ms / 1e3,
             pipeline=self.config.batch_pipeline)
             if self.config.micro_batch else None)
+        # leader-side scatter batching: concurrent /leader/start queries
+        # group into ONE batched RPC per worker (see leader_search /
+        # _scatter_search_batch). The reference fans out one JSON RPC per
+        # (query, worker) — Leader.java:51-70 — whose per-query Python
+        # cost caps the distributed path far below the engine beneath it.
+        self.scatter_batcher = (Coalescer(
+            self._scatter_search_batch,
+            max_batch=self.config.scatter_batch,
+            linger_s=self.config.scatter_linger_ms / 1e3,
+            pipeline=self.config.scatter_pipeline, name="scatter")
+            if (self.config.scatter_micro_batch
+                and not self.config.unbounded_results) else None)
         # near-real-time commit policy (Lucene NRT readers): uploads
         # defer the commit; the next search commits pending writes first,
         # so read-your-writes visibility matches the reference's
@@ -239,6 +267,8 @@ class SearchNode:
         self._pool.shutdown(wait=False)
         if self.batcher is not None:
             self.batcher.stop()
+        if self.scatter_batcher is not None:
+            self.scatter_batcher.stop()
 
     # ---- worker search path (Worker.java:175-186) ----
 
@@ -253,6 +283,20 @@ class SearchNode:
         if self.batcher is not None:
             return self.batcher.search(query, unbounded=unbounded)
         return self.engine.search(query, unbounded=unbounded)
+
+    def worker_search_batch(self, queries: list[str],
+                            k: int | None = None) -> list[list]:
+        """Score an already-formed query batch (the leader's batched
+        scatter RPC). Bypasses the micro-batcher — the batch needs no
+        linger for company — and runs the engine's batch path directly;
+        searches are pure functions of the committed snapshot, so
+        concurrent batch RPCs are safe."""
+        self.commit_if_dirty()
+        t0 = time.perf_counter()
+        out = self.engine.search_batch(queries, k=k)
+        global_metrics.observe("worker_batch_search",
+                               time.perf_counter() - t0)
+        return out
 
     def notify_write(self) -> None:
         """Mark uncommitted writes (called by the upload handler)."""
@@ -273,6 +317,15 @@ class SearchNode:
                         # stale pre-upload results forever
                         self._dirty = True
                         raise
+        else:
+            # a sibling search may have observed the same writes, cleared
+            # the flag, and STILL be mid-commit — searching now would see
+            # the pre-upload snapshot and break read-your-writes (an
+            # upload's 200 means the next search finds it, matching the
+            # reference's synchronous commit, Worker.java:138). Barrier
+            # on the lock: free when no commit is in flight.
+            with self._commit_lock:
+                pass
 
     # ---- session-expiry recovery ----
 
@@ -329,7 +382,14 @@ class SearchNode:
     def leader_search(self, query: str) -> dict[str, float]:
         """Scatter-gather search (``Leader.java:39-92``): fan the query out
         to every registered worker, tolerate per-worker failure, sum-merge
-        scores by document name."""
+        scores by document name.
+
+        Default path: concurrent queries coalesce into one batched RPC
+        per worker (:meth:`_scatter_search_batch`). The per-query JSON
+        fan-out below remains for unbounded-results (parity) configs and
+        ``scatter_micro_batch=False``."""
+        if self.scatter_batcher is not None:
+            return self.scatter_batcher.submit(query)
         workers = self.registry.get_all_service_addresses()
         log.info("scatter search", query=query, workers=len(workers))
 
@@ -355,6 +415,10 @@ class SearchNode:
             for hit in hits:
                 name = hit["document"]["name"]
                 merged[name] = merged.get(name, 0.0) + float(hit["score"])
+        return self._order_merged(merged)
+
+    def _order_merged(self, merged: dict[str, float]) -> dict[str, float]:
+        """Truncate + order one query's sum-merged scores."""
         if not self.config.unbounded_results:
             # each document lives on exactly one worker, so the global
             # top-k is contained in the union of per-worker top-ks —
@@ -366,6 +430,60 @@ class SearchNode:
             # alphabetical, the reference's TreeMap order (Leader.java:80-91)
             return dict(sorted(merged.items()))
         return dict(sorted(merged.items(), key=lambda kv: (-kv[1], kv[0])))
+
+    def _scatter_search_batch(
+            self, queries: list[str]) -> list[dict[str, float]]:
+        """Batched scatter-gather: ONE ``/worker/process-batch`` RPC per
+        worker for a whole coalesced query group, packed-binary replies
+        (:mod:`tfidf_tpu.cluster.wire`), per-query sum-merge at the
+        leader. Collapses the per-(query, worker) HTTP + JSON cost that
+        otherwise caps the distributed path (the reference pays it by
+        design, one RestTemplate POST per worker per query,
+        ``Leader.java:51-70``). Per-worker failures degrade to partial
+        results exactly like the per-query path."""
+        workers = self.registry.get_all_service_addresses()
+        live = set(workers)
+        body = json.dumps({"queries": queries,
+                           "k": self.config.top_k}).encode()
+
+        def one(addr: str) -> bytes:
+            global_injector.check("leader.worker_rpc")
+            t0 = time.perf_counter()
+            raw = self._scatter.post(
+                addr, "/worker/process-batch", body,
+                timeout=self.config.scatter_timeout_s, live=live)
+            global_metrics.observe("scatter_rpc",
+                                   time.perf_counter() - t0)
+            return raw
+
+        merged: list[dict[str, float]] = [{} for _ in queries]
+        futures = {self._pool.submit(one, w): w for w in workers}
+        for fut, addr in futures.items():
+            try:
+                raw = fut.result()
+                t0 = time.perf_counter()
+                hit_lists = unpack_hit_lists(raw)
+                global_metrics.observe("scatter_decode",
+                                       time.perf_counter() - t0)
+            except Exception as e:
+                # per-worker tolerance (Leader.java:67-69) — a reply
+                # that fails wire validation degrades to partial
+                # results exactly like a failed RPC
+                global_metrics.inc("scatter_failures")
+                log.warning("worker failed during batch search",
+                            worker=addr, err=repr(e))
+                continue
+            if len(hit_lists) != len(queries):
+                global_metrics.inc("scatter_failures")
+                log.warning("batch reply length mismatch", worker=addr)
+                continue
+            for m, hits in zip(merged, hit_lists):
+                for name, score in hits:
+                    m[name] = m.get(name, 0.0) + score
+        t0 = time.perf_counter()
+        out = [self._order_merged(m) for m in merged]
+        global_metrics.observe("scatter_merge", time.perf_counter() - t0)
+        return out
 
     # size polls are cached this long; between polls the leader grows
     # its local estimates by the bytes it placed, so bursts still spread
@@ -802,6 +920,26 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 # queries_served is counted once, by Searcher.search
                 self._json([{"document": {"name": h.name}, "score": h.score}
                             for h in hits])
+            elif u.path == "/worker/process-batch":
+                # batched scatter RPC (leader-internal; packed reply —
+                # see cluster/wire.py). The per-query endpoint above
+                # keeps the reference-compatible JSON shape.
+                global_injector.check("worker.process")
+                req = json.loads(self._body().decode("utf-8"))
+                queries = [str(q) for q in req.get("queries", ())]
+                k = req.get("k")
+                try:
+                    results = node.worker_search_batch(
+                        queries, k=int(k) if k is not None else None)
+                except Exception as e:
+                    # reference returns [] on any failure (Worker.java:183)
+                    log.warning("batch search failed", err=repr(e))
+                    results = [[] for _ in queries]
+                t0 = time.perf_counter()
+                body = pack_hit_lists(results)
+                global_metrics.observe("worker_batch_pack",
+                                       time.perf_counter() - t0)
+                self._send(200, body, "application/octet-stream")
             elif u.path == "/worker/upload":
                 name, data = self._read_upload(u)
                 if not name:

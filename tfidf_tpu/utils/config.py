@@ -64,8 +64,25 @@ class Config:
     # holds one pool thread per worker RPC; with C concurrent clients
     # and W workers the pool needs ~C*W threads or the scatter itself
     # becomes the concurrency cap (and the worker micro-batcher never
-    # sees full batches).
+    # sees full batches). (With scatter_micro_batch on, only the
+    # dispatcher threads use the pool: ~scatter_pipeline * W.)
     fanout_workers: int = 16
+    # Leader-side scatter batching: concurrent /leader/start queries
+    # coalesce into ONE /worker/process-batch RPC per worker (packed
+    # binary response, cluster/wire.py) instead of one JSON RPC per
+    # (query, worker). At high client concurrency the per-query HTTP +
+    # JSON Python cost on the worker is the serving-path ceiling
+    # (GIL-bound); batching collapses it to one RPC per batch.
+    # Unbounded-results (parity) configs use the per-query path.
+    scatter_micro_batch: bool = True
+    scatter_batch: int = 128
+    scatter_linger_ms: float = 2.0
+    # Concurrent scatter dispatcher threads: one batch's worker RPC
+    # round trip overlaps the next batch's formation.
+    scatter_pipeline: int = 2
+    # Per-RPC timeout for the batched scatter (covers a worker's NRT
+    # commit if an upload landed just before the batch).
+    scatter_timeout_s: float = 60.0
 
     # --- analyzer ---
     lowercase: bool = True

@@ -49,6 +49,9 @@ class Metrics:
                     out[f"{name}_mean_ms"] = round(total / n * 1e3, 3)
                     out[f"{name}_min_ms"] = round(mn * 1e3, 3)
                     out[f"{name}_max_ms"] = round(mx * 1e3, 3)
+                    # running sum: lets a scraper compute the mean over a
+                    # WINDOW from two snapshots (delta sum / delta count)
+                    out[f"{name}_sum_ms"] = round(total * 1e3, 3)
             return out
 
     def reset(self) -> None:

@@ -1,0 +1,141 @@
+"""Probe: REAL multi-process ``jax.distributed`` at corpus scale.
+
+Spawns N OS processes (CPU backend, 2 virtual devices each), joins them
+with ``jax.distributed.initialize`` into one global device view, and runs
+the mesh engine's ingest + commit + search over a ("docs", "terms") mesh
+whose docs axis SPANS process boundaries — the global-df psum and top-k
+all_gather run over the gloo collective backend, the same SPMD shape a
+DCN-connected TPU pod executes (SURVEY.md §5.8). Every process checks
+oracle parity (vs the single-device local engine on identical inputs) and
+process 0 writes ``MULTIHOST.json``.
+
+Usage: python probe_multihost.py            (parent; writes the artifact)
+       python probe_multihost.py worker ... (subprocess body)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+N_PROCESSES = 4
+DEVICES_PER_PROC = 2
+N_DOCS = 2000
+VOCAB = 5000
+AVG_LEN = 40
+N_QUERIES = 64
+
+
+def worker(coord: str, n: int, pid: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVICES_PER_PROC}")
+    import jax
+    import numpy as np
+
+    from tfidf_tpu.parallel.mesh import initialize_multihost, make_mesh
+
+    assert initialize_multihost(coord, num_processes=n, process_id=pid)
+    n_dev = len(jax.devices())
+    assert n_dev == n * DEVICES_PER_PROC
+
+    from tfidf_tpu.engine.engine import Engine
+    from tfidf_tpu.utils.config import Config
+
+    rng = np.random.default_rng(11)   # identical corpus on every process
+    texts = []
+    for _ in range(N_DOCS):
+        ln = max(int(rng.poisson(AVG_LEN)), 3)
+        ids = rng.zipf(1.3, size=ln) % VOCAB
+        texts.append(" ".join(f"t{w}" for w in ids))
+    queries = []
+    for _ in range(N_QUERIES):
+        ids = rng.zipf(1.3, size=int(rng.integers(2, 5))) % VOCAB
+        queries.append(" ".join(f"t{w}" for w in ids))
+
+    def cfg(sub: str, mode: str) -> Config:
+        return Config(documents_path=f"/tmp/probe_mh_{pid}_{sub}",
+                      engine_mode=mode, mesh_layout="ell",
+                      min_doc_capacity=256, min_nnz_capacity=1 << 14,
+                      min_vocab_capacity=1 << 13, query_batch=32,
+                      max_query_terms=8)
+
+    mesh = make_mesh((n_dev // 2, 2))
+    eng = Engine(cfg("m", "mesh"), mesh=mesh)
+    local = Engine(cfg("l", "local"))
+
+    t0 = time.perf_counter()
+    for i, t in enumerate(texts):
+        eng.ingest_text(f"d{i}", t)
+    ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    eng.commit()
+    commit_s = time.perf_counter() - t0
+
+    for i, t in enumerate(texts):
+        local.ingest_text(f"d{i}", t)
+    local.commit()
+
+    eng.search_batch(queries[:32])   # warm
+    t0 = time.perf_counter()
+    got = eng.search_batch(queries)
+    search_s = time.perf_counter() - t0
+    want = local.search_batch(queries)
+
+    for qi, (g, w) in enumerate(zip(got, want)):
+        gs = sorted((round(h.score, 4) for h in g), reverse=True)
+        ws = sorted((round(h.score, 4) for h in w), reverse=True)
+        # exact score multiset parity; names must match exactly above
+        # the k-boundary score (WHICH of several boundary-tied docs make
+        # the cut is legitimately layout-dependent)
+        assert gs == ws, (qi, queries[qi], gs, ws)
+        if gs:
+            boundary = gs[-1]
+            gn = {h.name for h in g if round(h.score, 4) > boundary}
+            wn = {h.name for h in w if round(h.score, 4) > boundary}
+            assert gn == wn, (qi, queries[qi], gn, wn)
+
+    result = {
+        "num_processes": n, "devices": n_dev,
+        "mesh": {"docs": n_dev // 2, "terms": 2},
+        "collective_backend": "gloo (cpu); ICI/DCN on TPU pods",
+        "n_docs": N_DOCS, "n_queries": N_QUERIES,
+        "ingest_s": round(ingest_s, 2), "commit_s": round(commit_s, 2),
+        "search_qps": round(N_QUERIES / search_s, 1),
+        "parity": "mesh == local engine top-10, all queries, "
+                  "checked on every process",
+        "layout": "ell",
+    }
+    if pid == 0:
+        with open(os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "MULTIHOST.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    print(f"MULTIHOST_OK pid={pid} {json.dumps(result)}", flush=True)
+
+
+def main() -> None:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "TFIDF_JAX_PLATFORM"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "worker",
+         f"127.0.0.1:{port}", str(N_PROCESSES), str(i)], env=env)
+        for i in range(N_PROCESSES)]
+    rc = [p.wait(timeout=900) for p in procs]
+    assert all(r == 0 for r in rc), rc
+    print("ALL_OK")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        worker(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+    else:
+        main()

@@ -31,16 +31,21 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main():
+def run_mode(mode: str, corpus, queries) -> dict:
+    """Build -> save -> restore -> parity for one index mode.
+
+    ``mode="segments"`` is the streaming flagship: ingest in 100k-doc
+    commit waves (a real segment list + tiered merges), then restore
+    through the segment-level fast path (segstate.npz)."""
     from tfidf_tpu.engine import Engine
     from tfidf_tpu.engine.checkpoint import (load_checkpoint,
                                              save_checkpoint)
     from tfidf_tpu.utils.config import Config
 
-    rng = np.random.default_rng(0)
-    offsets, ids, tfs, lengths = make_doc_arrays(rng, N_DOCS, NS_VOCAB,
-                                                 AVG_LEN)
-    engine = Engine(Config(query_batch=64))
+    offsets, ids, tfs, lengths = corpus
+    cfg = Config(query_batch=64,
+                 index_mode="segments" if mode == "segments" else "rebuild")
+    engine = Engine(cfg)
     for i in range(NS_VOCAB):
         engine.vocab.add(f"t{i}")
     t0 = time.perf_counter()
@@ -48,21 +53,30 @@ def main():
     for i in range(N_DOCS):
         lo, hi = offsets[i], offsets[i + 1]
         add(f"d{i}", ids[lo:hi], tfs[lo:hi], float(lengths[i]))
+        if mode == "segments" and (i + 1) % 100_000 == 0:
+            engine.commit()
     engine.commit()
-    log(f"[ckpt] built {N_DOCS}-doc engine in "
+    if mode == "segments":
+        engine.index.wait_for_merges()
+        engine.commit()
+    log(f"[ckpt:{mode}] built {N_DOCS}-doc engine in "
         f"{time.perf_counter()-t0:.0f}s")
-    queries = make_queries(rng, NS_VOCAB, 64)
     want = engine.search_batch(queries, k=10)
 
-    tmp = tempfile.mkdtemp(prefix="probe_ckpt_")
+    tmp = tempfile.mkdtemp(prefix=f"probe_ckpt_{mode}_")
     try:
         t0 = time.perf_counter()
         save_checkpoint(engine, tmp)
         save_s = time.perf_counter() - t0
+        n_segments = (len(engine.index._segments)
+                      if mode == "segments" else None)
         del engine
         t0 = time.perf_counter()
-        restored = load_checkpoint(tmp, Config(query_batch=64))
+        restored = load_checkpoint(tmp, cfg)
         load_s = time.perf_counter() - t0
+        if mode == "segments":
+            assert len(restored.index._segments) == n_segments, \
+                "restore must reproduce the segment list, not rebuild"
         t0 = time.perf_counter()
         got = restored.search_batch(queries, k=10)
         first_search_s = time.perf_counter() - t0
@@ -70,17 +84,30 @@ def main():
             assert [h.name for h in w] == [h.name for h in g]
             np.testing.assert_allclose([h.score for h in w],
                                        [h.score for h in g], rtol=1e-6)
-        out = {"n_docs": N_DOCS, "nnz": int(ids.shape[0]),
+        out = {"n_docs": N_DOCS,
                "save_s": round(save_s, 1),
                "restore_s": round(load_s, 1),
                "first_search_s": round(first_search_s, 1),
                "parity_checked": True}
-        log(f"[ckpt] save {save_s:.1f}s, restore {load_s:.1f}s, "
+        if n_segments is not None:
+            out["segments"] = n_segments
+        log(f"[ckpt:{mode}] save {save_s:.1f}s, restore {load_s:.1f}s, "
             f"first search {first_search_s:.1f}s, top-10 identical "
             f"on {len(queries)} queries")
-        print(json.dumps(out))
+        return out
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    corpus = make_doc_arrays(rng, N_DOCS, NS_VOCAB, AVG_LEN)
+    queries = make_queries(rng, NS_VOCAB, 64)
+    modes = os.environ.get("PROBE_MODES", "shard,segments").split(",")
+    out = {"nnz": int(corpus[1].shape[0])}
+    for mode in modes:
+        out[mode] = run_mode(mode.strip(), corpus, queries)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Serving-node checkpointing (VERDICT r4 #5): /admin/checkpoint, the
+autosave loop, and restore-at-boot with an mtime-gated partial re-walk."""
+
+import json
+import os
+import time
+
+from tfidf_tpu.cluster.coordination import CoordinationCore, LocalCoordination
+from tfidf_tpu.cluster.node import SearchNode, http_post
+from tfidf_tpu.engine.checkpoint import load_checkpoint
+from tfidf_tpu.utils.config import Config
+
+from tests.test_cluster import wait_until
+
+
+def _cfg(tmp_path, sub, **kw):
+    return Config(documents_path=str(tmp_path / sub / "documents"),
+                  index_path=str(tmp_path / sub / "index"),
+                  port=0, min_doc_capacity=64, min_nnz_capacity=1 << 12,
+                  min_vocab_capacity=1 << 10, query_batch=4,
+                  max_query_terms=8, **kw)
+
+
+def test_admin_checkpoint_and_restore_at_boot(tmp_path):
+    core = CoordinationCore(session_timeout_s=0.5)
+    cfg = _cfg(tmp_path, "n0", index_mode="segments")
+    node = SearchNode(cfg, coord=LocalCoordination(core, 0.1)).start()
+    try:
+        for i in range(8):
+            http_post(node.url + f"/worker/upload?name=d{i}.txt",
+                      f"shared token{i} body".encode(),
+                      content_type="application/octet-stream")
+        # NRT: a search commits pending writes, then checkpoint
+        resp = json.loads(http_post(node.url + "/admin/checkpoint", b""))
+        assert resp["docs"] == 8
+        assert os.path.isdir(resp["dir"])
+    finally:
+        node.stop()
+        core.close()
+
+    # "pod restart": restore from the checkpoint, then re-walk only
+    # files newer than the save
+    with open(os.path.join(resp["dir"], "meta.json")) as f:
+        created = json.load(f)["created_at"]
+    engine = load_checkpoint(resp["dir"], cfg)
+    assert engine.index.num_live_docs == 8
+    # age the pre-checkpoint files past the clock-skew slack (in a real
+    # deployment they'd be minutes-to-days older than the save)
+    for i in range(8):
+        p = os.path.join(cfg.documents_path, f"d{i}.txt")
+        os.utime(p, (created - 3600, created - 3600))
+    # a document uploaded AFTER the checkpoint (newer mtime) must be
+    # picked up by the partial re-walk; the old ones are skipped
+    late = os.path.join(cfg.documents_path, "late.txt")
+    with open(late, "w") as f:
+        f.write("shared latecomer")
+    os.utime(late, (created + 120, created + 120))
+    seen_before = engine.index.num_live_docs
+    n = engine.build_from_directory(newer_than=created - 60.0)
+    assert n < 8 + 1   # NOT a full re-walk
+    assert engine.index.num_live_docs == seen_before + 1
+    core2 = CoordinationCore(session_timeout_s=0.5)
+    node2 = SearchNode(cfg, coord=LocalCoordination(core2, 0.1),
+                       engine=engine).start(rebuild=False)
+    try:
+        hits = json.loads(http_post(node2.url + "/worker/process",
+                                    b"shared"))
+        names = {h["document"]["name"] for h in hits}
+        assert "late.txt" in names and "d0.txt" in names
+    finally:
+        node2.stop()
+        core2.close()
+
+
+def test_autosave_loop_saves_dirty_state(tmp_path):
+    core = CoordinationCore(session_timeout_s=0.5)
+    cfg = _cfg(tmp_path, "n1", checkpoint_interval_s=0.3)
+    node = SearchNode(cfg, coord=LocalCoordination(core, 0.1)).start()
+    try:
+        http_post(node.url + "/worker/upload?name=a.txt", b"hello world",
+                  content_type="application/octet-stream")
+        assert wait_until(
+            lambda: os.path.isdir(node.checkpoint_dir), timeout=5.0)
+        # the autosave captured the doc (it commits via the engine state,
+        # not the NRT flag — load and check)
+        assert wait_until(
+            lambda: load_checkpoint(node.checkpoint_dir,
+                                    cfg).index.num_live_docs == 1,
+            timeout=5.0)
+    finally:
+        node.stop()
+        core.close()

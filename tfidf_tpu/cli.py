@@ -76,7 +76,46 @@ def cmd_serve(args) -> int:
             cfg.coordinator_address,
             heartbeat_interval_s=cfg.heartbeat_interval_s)
 
-    node = SearchNode(cfg, coord_factory=factory).start()
+    # restore-at-boot: a serving node with a checkpoint loads it and then
+    # re-walks only documents written after the save (the reference
+    # restores by re-walking everything, Worker.java:77-94)
+    engine = None
+    newer_than = None
+    ckpt_dir = cfg.checkpoint_path or os.path.join(cfg.index_path,
+                                                   "checkpoint")
+    if os.path.isdir(ckpt_dir):
+        from tfidf_tpu.engine.checkpoint import load_checkpoint
+        try:
+            engine = load_checkpoint(ckpt_dir, cfg)
+            with open(os.path.join(ckpt_dir, "meta.json"),
+                      encoding="utf-8") as f:
+                created = json.load(f).get("created_at")
+            if created:
+                newer_than = float(created) - 60.0   # clock-skew slack
+            # reconcile deletions: the partial re-walk only UPSERTS, so
+            # a document removed from the documents dir since the save
+            # would otherwise be resurrected from the checkpoint forever
+            # (the directory is the source of truth, Worker.java:77-94)
+            if os.path.isdir(cfg.documents_path):
+                removed = 0
+                for e in list(engine.index.live_entries()):
+                    if not os.path.isfile(
+                            os.path.join(cfg.documents_path, e.name)):
+                        engine.delete(e.name)
+                        removed += 1
+                if removed:
+                    engine.commit()
+                    log.info("dropped checkpointed docs missing from "
+                             "documents dir", removed=removed)
+            log.info("restored from checkpoint", dir=ckpt_dir,
+                     docs=engine.index.num_live_docs)
+        except Exception as e:
+            log.warning("checkpoint restore failed; full rebuild",
+                        err=repr(e))
+            engine = None
+
+    node = SearchNode(cfg, coord_factory=factory, engine=engine).start(
+        rebuild_newer_than=newer_than)
     print(f"node up at {node.url} "
           f"({'leader' if node.is_leader() else 'worker'}); "
           f"coordinator {cfg.coordinator_address}", flush=True)

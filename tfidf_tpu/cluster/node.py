@@ -35,6 +35,7 @@ from __future__ import annotations
 import email.parser
 import email.policy
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -118,10 +119,21 @@ class _ScatterClient:
                 c.timeout = timeout
                 if c.sock is not None:
                     c.sock.settimeout(timeout)
-            if c is None:
-                c = conns[base] = http.client.HTTPConnection(
-                    u.hostname, u.port, timeout=timeout)
             try:
+                if c is None:
+                    import socket as _socket
+                    c = http.client.HTTPConnection(
+                        u.hostname, u.port, timeout=timeout)
+                    c.connect()
+                    # http.client leaves Nagle on; with the unbuffered
+                    # small-write HTTP framing both sides use, Nagle +
+                    # delayed ACK can add tens of ms per RPC. Cache only
+                    # AFTER the connect + setsockopt succeed — a cached
+                    # never-connected object would auto-reconnect inside
+                    # request() later without TCP_NODELAY
+                    c.sock.setsockopt(_socket.IPPROTO_TCP,
+                                      _socket.TCP_NODELAY, 1)
+                    conns[base] = c
                 c.request("POST", path, body=data, headers={
                     "Content-Type": "application/json"})
                 r = c.getresponse()
@@ -235,6 +247,19 @@ class SearchNode:
         # workers — exactly the double-count the map exists to prevent
         self._placement_lock = threading.Lock()
 
+        # serving-node durability (the reference commits its Lucene index
+        # on every upload, Worker.java:138): an on-demand /admin/checkpoint
+        # endpoint plus an optional periodic autosave of dirty state
+        self.checkpoint_dir = (self.config.checkpoint_path
+                               or os.path.join(self.config.index_path,
+                                               "checkpoint"))
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_thread = None
+        if self.config.checkpoint_interval_s > 0:
+            self._ckpt_thread = threading.Thread(
+                target=self._autosave_loop, daemon=True,
+                name=f"ckpt-{self.config.port}")
+
         handler = type("Handler", (_NodeHandler,), {"node": self})
         self.httpd = _NodeServer(
             (self.config.host, self.config.port), handler)
@@ -248,15 +273,59 @@ class SearchNode:
 
     # ---- lifecycle (app/Application.java:33-46) ----
 
-    def start(self, rebuild: bool = True) -> "SearchNode":
+    def start(self, rebuild: bool = True,
+              rebuild_newer_than: float | None = None) -> "SearchNode":
         self._server_thread.start()
-        if rebuild:   # boot-time re-walk (Worker.java:77-88)
-            self.engine.build_from_directory()
+        if rebuild:   # boot-time re-walk (Worker.java:77-88); after a
+            # checkpoint restore only documents written since the save
+            # are re-analyzed (idempotent upserts)
+            self.engine.build_from_directory(
+                newer_than=rebuild_newer_than)
         self.election.volunteer_for_leadership()
         self.election.reelect_leader()
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.start()
         log.info("node started", url=self.url,
                  leader=self.election.is_leader())
         return self
+
+    # ---- serving-node checkpoints ----
+
+    def save_checkpoint(self) -> dict:
+        """Checkpoint the engine to this node's checkpoint dir (used by
+        /admin/checkpoint and the autosave loop). Serialized by a lock —
+        overlapping saves would race on the version directory."""
+        from tfidf_tpu.engine.checkpoint import save_checkpoint
+        with self._ckpt_lock:
+            t0 = time.perf_counter()
+            save_checkpoint(self.engine, self.checkpoint_dir)
+            dt = time.perf_counter() - t0
+        global_metrics.inc("checkpoints_saved")
+        global_metrics.observe("checkpoint_save", dt)
+        return {"dir": self.checkpoint_dir,
+                "docs": self.engine.index.num_live_docs,
+                "seconds": round(dt, 2)}
+
+    def _autosave_loop(self) -> None:
+        interval = self.config.checkpoint_interval_s
+        last_state = None
+        while not self._stopping:
+            time.sleep(interval)
+            if self._stopping:
+                return
+            try:
+                # flush deferred upload commits first — otherwise an
+                # upload burst with no intervening search leaves _dirty
+                # set and the loop re-saves the identical corpus forever
+                self.commit_if_dirty()
+                state = (self.engine.index.num_live_docs,
+                         getattr(self.engine.index, "_gen", None))
+                if state == last_state:
+                    continue   # nothing new since the last save
+                self.save_checkpoint()
+                last_state = state
+            except Exception as e:
+                log.warning("autosave checkpoint failed", err=repr(e))
 
     def stop(self) -> None:
         self._stopping = True
@@ -820,6 +889,10 @@ class _NodeServer(ThreadingHTTPServer):
 class _NodeHandler(BaseHTTPRequestHandler):
     node: SearchNode   # bound by SearchNode.__init__
     protocol_version = "HTTP/1.1"
+    # the handler's wfile is unbuffered (wbufsize=0): status line, each
+    # header, and the body go out as separate small writes — with Nagle
+    # on, write N+1 can stall behind the peer's delayed ACK of write N
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):
         pass
@@ -859,14 +932,21 @@ class _NodeHandler(BaseHTTPRequestHandler):
         """The search query: accept raw text (the reference POSTs the bare
         query string, ``Leader.java:54-59``) or ``{"query": ...}`` JSON."""
         body = self._body().decode("utf-8", "replace")
-        try:
-            obj = json.loads(body)
-            if isinstance(obj, dict) and "query" in obj:
-                return str(obj["query"])
-            if isinstance(obj, str):
-                return obj
-        except json.JSONDecodeError:
-            pass
+        # only attempt JSON when the body can be JSON — this is the
+        # per-request hot path, and a raised-and-caught JSONDecodeError
+        # per query is measurable at thousands of q/s. Strip leading
+        # whitespace first: json.loads tolerates it, so the gate must too
+        if body[:1].isspace():
+            body = body.lstrip()
+        if body[:1] in ('{', '"'):
+            try:
+                obj = json.loads(body)
+                if isinstance(obj, dict) and "query" in obj:
+                    return str(obj["query"])
+                if isinstance(obj, str):
+                    return obj
+            except json.JSONDecodeError:
+                pass
         return body
 
     # ---- routing ----
@@ -981,6 +1061,11 @@ class _NodeHandler(BaseHTTPRequestHandler):
                         node.notify_write()
                 self._json({"indexed": len(docs) - len(skipped),
                             "skipped": skipped})
+            elif u.path == "/admin/checkpoint":
+                # on-demand durability point (reference analog: the
+                # per-upload indexWriter.commit(), Worker.java:138)
+                node.commit_if_dirty()
+                self._json(node.save_checkpoint())
             elif u.path == "/leader/upload-batch":
                 docs = json.loads(self._body().decode("utf-8"))
                 try:

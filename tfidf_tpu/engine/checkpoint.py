@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 
 import numpy as np
 
@@ -104,7 +105,21 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
             arrays["name_order"] = np.fromiter(
                 (pos[nm] for nm in snap_names), np.int64, n)
             np.savez(os.path.join(vdir, "snapshot.npz"), **arrays)
-            snap_meta = {"score_signature": _score_signature(engine)}
+            snap_meta = {"score_signature": _score_signature(engine),
+                         "kind": "shard"}
+    # segment-level full-state payload (streaming mode fast restore,
+    # VERDICT r4 #5): same gen-token consistency discipline
+    full = (engine.index.export_full_state()
+            if engine.config.checkpoint_snapshot_arrays
+            and hasattr(engine.index, "export_full_state")
+            and entries_gen is not None
+            else None)
+    if full is not None:
+        arrays, full_gen = full
+        if full_gen == entries_gen:
+            np.savez(os.path.join(vdir, "segstate.npz"), **arrays)
+            snap_meta = {"score_signature": _score_signature(engine),
+                         "kind": "segments"}
     with open(os.path.join(vdir, "meta.json"), "w", encoding="utf-8") as f:
         json.dump({
             "format_version": FORMAT_VERSION,
@@ -113,6 +128,11 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
             "nnz": nnz,
             "vocab_size": len(engine.vocab),
             "snapshot": snap_meta,
+            # wall-clock save time: serve's boot re-walk only re-ingests
+            # files modified after this (minus slack), keeping the
+            # reference's rebuild-from-documents property without paying
+            # a full re-analysis after every restart
+            "created_at": time.time(),
         }, f)
     fault_point("checkpoint.pre_publish")   # crash window for fault tests
     # Atomic publish: swing the symlink in one os.replace. <base> always
@@ -152,6 +172,27 @@ def load_checkpoint(directory: str, config: Config | None = None) -> Engine:
     term_ids = data["term_ids"]
     tfs = data["tfs"]
     lengths = data["lengths"]
+    # segment-level fast path (streaming mode): rebuild the committed
+    # segment list from segstate.npz — device work is pure uploads, no
+    # O(corpus) host re-layout, no per-doc replay
+    seg_path = os.path.join(directory, "segstate.npz")
+    snap_meta_pre = meta.get("snapshot") or {}
+    if (snap_meta_pre.get("kind") == "segments"
+            and os.path.exists(seg_path)
+            and hasattr(engine.index, "install_full_state")
+            and snap_meta_pre.get("score_signature")
+            == _score_signature(engine)):
+        from tfidf_tpu.engine.index import entries_from_packed
+        entries = entries_from_packed(
+            names, np.ascontiguousarray(offsets, np.int64),
+            np.ascontiguousarray(term_ids, np.int32),
+            np.ascontiguousarray(tfs, np.float32),
+            np.ascontiguousarray(lengths, np.float32))
+        engine.index.install_full_state(np.load(seg_path), entries)
+        engine.commit()
+        log.info("checkpoint loaded", dir=directory, docs=len(names),
+                 fast_snapshot="segments")
+        return engine
     # bulk restore: docs.npz already stores exactly the packed arrays
     # the index wants. Indexes with a packed loader (ShardIndex) take
     # them whole — no per-document Python loop, and the following

@@ -201,10 +201,16 @@ class Engine:
             self.index.commit(self.vocab.capacity())
         log.info("commit", ms=sw.ms, docs=self.index.num_live_docs)
 
-    def build_from_directory(self, docs_path: str | None = None) -> int:
+    def build_from_directory(self, docs_path: str | None = None,
+                             newer_than: float | None = None) -> int:
         """Recovery-by-rebuild: walk the documents dir, upsert every regular
         file keyed by its relative path, then commit (``Worker.java:77-88``).
-        Idempotent — safe to run on a non-empty index."""
+        Idempotent — safe to run on a non-empty index.
+
+        ``newer_than`` (unix mtime): skip files older than this — the
+        checkpoint-restore boot path re-walks only documents written
+        after the checkpoint, keeping the always-reconstructible
+        property without re-analyzing the whole corpus."""
         root = docs_path or self.config.documents_path
         n = 0
         if os.path.isdir(root):
@@ -212,6 +218,12 @@ class Engine:
                 for fn in sorted(filenames):
                     full = os.path.join(dirpath, fn)
                     rel = os.path.relpath(full, root)
+                    if newer_than is not None:
+                        try:
+                            if os.path.getmtime(full) < newer_than:
+                                continue
+                        except OSError:
+                            continue
                     try:
                         with open(full, "rb") as f:
                             self.ingest_text(rel, extract_text(f.read()))

@@ -50,6 +50,20 @@ class DocEntry:
     live: bool = True
 
 
+def entries_from_packed(names: list[str], offsets: np.ndarray,
+                        term_ids: np.ndarray, tfs: np.ndarray,
+                        lengths: np.ndarray) -> list["DocEntry"]:
+    """Doc-table construction from packed CSR-style checkpoint arrays
+    with per-doc numpy VIEWS (no copies, no per-document ingest work) —
+    shared by every index kind's bulk-restore path."""
+    lo = offsets[:-1].tolist()
+    hi = offsets[1:].tolist()
+    lens = lengths.tolist()
+    return [DocEntry(name=names[i], term_ids=term_ids[lo[i]:hi[i]],
+                     tfs=tfs[lo[i]:hi[i]], length=lens[i])
+            for i in range(len(names))]
+
+
 @dataclass
 class Snapshot:
     """Immutable device-resident index state — what queries score against.
@@ -190,13 +204,8 @@ class ShardIndex:
         with self._write_lock:
             if self._docs:
                 raise ValueError("bulk_load_packed requires an empty index")
-            lo = offsets[:-1].tolist()
-            hi = offsets[1:].tolist()
-            lens = lengths.tolist()
-            self._docs = [
-                DocEntry(name=names[i], term_ids=term_ids[lo[i]:hi[i]],
-                         tfs=tfs[lo[i]:hi[i]], length=lens[i])
-                for i in range(n)]
+            self._docs = entries_from_packed(names, offsets, term_ids,
+                                             tfs, lengths)
             self._by_name = dict(zip(names, range(n)))
             if len(self._by_name) != n:
                 self._docs, self._by_name = [], {}

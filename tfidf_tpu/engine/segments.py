@@ -335,20 +335,223 @@ class SegmentedIndex:
 
     def live_entries(self) -> list[DocEntry]:
         with self._write_lock:
-            out = []
-            for seg in self._segments:
-                out.extend(d for d, alive in zip(seg.host_docs, seg.live)
-                           if alive)
-            out.extend(d for d in self._pending if d.live)
-            return out
+            return self._live_entries_locked()
+
+    def _live_entries_locked(self) -> list[DocEntry]:
+        out = []
+        for seg in self._segments:
+            out.extend(d for d, alive in zip(seg.host_docs, seg.live)
+                       if alive)
+        out.extend(d for d in self._pending if d.live)
+        return out
+
+    def live_entries_and_gen(self) -> tuple[list[DocEntry], int]:
+        """Entries plus the generation they were read at, atomically —
+        the checkpoint-save consistency token (same contract as
+        ``ShardIndex.live_entries_and_gen``)."""
+        with self._write_lock:
+            return self._live_entries_locked(), self._gen
+
+    # ---- checkpoint restore surfaces ----
+
+    def bulk_load_packed(self, names: list[str], offsets: np.ndarray,
+                         term_ids: np.ndarray, tfs: np.ndarray,
+                         lengths: np.ndarray) -> None:
+        """Generic checkpoint-restore path: register the whole packed doc
+        table as pending (per-doc numpy VIEWS, no per-document Python
+        ingest — the loop VERDICT r3/r4 flagged); the next commit builds
+        ONE segment from it. ``install_full_state`` is the faster path
+        that also skips that commit's O(corpus) layout."""
+        from tfidf_tpu.engine.index import entries_from_packed
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        term_ids = np.ascontiguousarray(term_ids, np.int32)
+        tfs = np.ascontiguousarray(tfs, np.float32)
+        lengths = np.ascontiguousarray(lengths, np.float32)
+        entries = entries_from_packed(names, offsets, term_ids, tfs,
+                                      lengths)
+        n = len(names)
+        with self._write_lock:
+            if self._pending or self._segments:
+                raise ValueError("bulk_load_packed requires an empty index")
+            self._where = {e.name: (None, i)
+                           for i, e in enumerate(entries)}
+            if len(self._where) != n:
+                self._where = {}
+                raise ValueError("bulk_load_packed: duplicate names")
+            self._pending = entries
+            self._nnz_live_stat = int(offsets[-1])
+            self._bytes_live_stat = int(term_ids.nbytes + tfs.nbytes)
+            self._gen += 1
+        global_metrics.inc("docs_indexed", n)
+
+    def export_full_state(self) -> tuple[dict, int] | None:
+        """Segment-level fast-restore payload: every segment's blocked-ELL
+        layout (REBUILT ON HOST from the retained postings — no
+        device->host fetch, which matters on thin-downlink device links),
+        df, raw lengths, live mask, name table, and the mapping of live
+        rows into the ``live_entries()`` order that docs.npz stores.
+        Returns ``(arrays, gen)`` or None when pending docs exist
+        (commit first) — pending docs belong to no segment yet.
+
+        Layout note: the blocked-ELL builder requires rows sorted by
+        length descending, so export re-sorts each segment's rows and
+        stores EVERY per-row table (names, live, raw_len, gid) in that
+        same permuted order — the payload is internally consistent, and
+        a segment's internal row order is not observable (hits resolve
+        through the stored name table). Rows tombstoned since the
+        original build re-export with their retained postings; rows
+        restored as dead placeholders re-export empty, which is
+        scoring-equivalent (masked, df kept verbatim)."""
+        with self._write_lock:
+            if self._pending:
+                return None
+            segs = list(self._segments)
+            # live masks mutate in place on delete/upsert — copy them
+            # under the lock so the payload can't tear against a
+            # concurrent tombstone (the gen recheck below then catches
+            # any mutation that landed while the payload was built)
+            seg_live = [np.asarray(s.live, bool).copy() for s in segs]
+            gen = self._gen
+        out: dict[str, np.ndarray] = {
+            "format": np.int64(1), "nseg": np.int64(len(segs))}
+        base = 0
+        for i, seg in enumerate(segs):
+            order = np.argsort([-d.term_ids.shape[0]
+                                for d in seg.host_docs], kind="stable")
+            docs = [seg.host_docs[k] for k in order]
+            live = seg_live[i][order]
+            names = [seg.names[k] for k in order]
+            raw_len = np.asarray(seg.raw_len, np.float32)[order]
+            ell, _df, _raw, _dl, doc_cap, _nnz = self._layout_host(
+                docs, len(seg.df))
+            if doc_cap != seg.doc_cap:
+                return None   # capacity drift; fall back to slow path
+            out[f"s{i}_nb"] = np.int64(len(ell.blocks))
+            for j, blk in enumerate(ell.blocks):
+                out[f"s{i}_b{j}_tf"] = blk.tf
+                out[f"s{i}_b{j}_term"] = blk.term
+                out[f"s{i}_b{j}_rows"] = np.int64(blk.n_rows)
+            out[f"s{i}_res_nnz"] = np.int64(ell.res_nnz)
+            if ell.res_nnz:
+                out[f"s{i}_res_tf"] = ell.res_tf
+                out[f"s{i}_res_term"] = ell.res_term
+                out[f"s{i}_res_doc"] = ell.res_doc
+            out[f"s{i}_df"] = seg.df
+            out[f"s{i}_raw_len"] = raw_len
+            out[f"s{i}_live"] = live
+            out[f"s{i}_names"] = np.asarray(names)
+            out[f"s{i}_doc_cap"] = np.int64(seg.doc_cap)
+            out[f"s{i}_nnz"] = np.int64(seg.nnz_total)
+            # live rows -> position in the live_entries() global order.
+            # live_entries iterates host_docs in STORED order, so rank
+            # live rows by their pre-permutation position
+            stored_rank = np.full(seg.n_docs, -1, np.int64)
+            k = 0
+            for local, alive in enumerate(seg_live[i]):
+                if alive:
+                    stored_rank[local] = base + k
+                    k += 1
+            out[f"s{i}_gid"] = stored_rank[order]
+            base += k
+        with self._write_lock:
+            if self._gen != gen:
+                # a delete/upsert/merge-splice landed while the payload
+                # was built; the caller's gen token would still match
+                # its own (earlier) read, so refuse here
+                return None
+        return out, gen
+
+    def install_full_state(self, data, entries: list[DocEntry]) -> None:
+        """Rebuild the segment list from an :meth:`export_full_state`
+        payload plus the live entries (docs.npz order). Device work is
+        pure uploads of the stored layout — no O(corpus) host re-layout.
+        The caller publishes the snapshot with a normal ``commit()``."""
+        if int(data["format"]) != 1:
+            raise ValueError("unknown segment-state format")
+        nseg = int(data["nseg"])
+        segs: list[Segment] = []
+        where: dict[str, tuple[Segment, int]] = {}
+        for i in range(nseg):
+            names = [str(x) for x in data[f"s{i}_names"]]
+            live = np.asarray(data[f"s{i}_live"], bool).copy()
+            gid = data[f"s{i}_gid"]
+            n = len(names)
+            host_docs: list[DocEntry] = []
+            for local in range(n):
+                g = int(gid[local])
+                if g >= 0:
+                    e = entries[g]
+                    if e.name != names[local]:
+                        raise ValueError("segment-state/doc-table skew")
+                    host_docs.append(e)
+                else:
+                    host_docs.append(DocEntry(
+                        name=names[local],
+                        term_ids=np.empty(0, np.int32),
+                        tfs=np.empty(0, np.float32),
+                        length=0.0, live=False))
+            doc_cap = int(data[f"s{i}_doc_cap"])
+            raw_len = np.asarray(data[f"s{i}_raw_len"], np.float32)
+            doc_len = np.zeros(doc_cap, np.float32)
+            doc_len[:n] = self.model.transform_doc_len(raw_len)
+            tfs_d, terms_d, dls_d, norms0, rows, caps = \
+                [], [], [], [], [], []
+            row0 = 0
+            for j in range(int(data[f"s{i}_nb"])):
+                tf = data[f"s{i}_b{j}_tf"]
+                nr = int(data[f"s{i}_b{j}_rows"])
+                cap = tf.shape[0]
+                dl = np.zeros(cap, np.float32)
+                dl[:nr] = doc_len[row0:row0 + nr]
+                tfs_d.append(jnp.asarray(tf))
+                terms_d.append(jnp.asarray(data[f"s{i}_b{j}_term"]))
+                dls_d.append(jnp.asarray(dl))
+                norms0.append(jnp.zeros(cap, jnp.float32))
+                rows.append(nr)
+                caps.append(cap)
+                row0 += nr
+            if int(data[f"s{i}_res_nnz"]):
+                res_tf = jnp.asarray(data[f"s{i}_res_tf"])
+                res_term = jnp.asarray(data[f"s{i}_res_term"])
+                res_doc = jnp.asarray(data[f"s{i}_res_doc"])
+                doc_len_d = jnp.asarray(doc_len)
+            else:
+                res_tf = res_term = res_doc = doc_len_d = None
+            seg = Segment(
+                tfs=tuple(tfs_d), terms=tuple(terms_d),
+                dls=tuple(dls_d), norms0=tuple(norms0),
+                block_live=jnp.asarray(np.asarray(rows, np.int32)),
+                block_rows=tuple(rows), block_caps=tuple(caps),
+                doc_cap=doc_cap, names=names,
+                df=np.asarray(data[f"s{i}_df"], np.float32),
+                raw_len=raw_len, host_docs=host_docs,
+                res_tf=res_tf, res_term=res_term, res_doc=res_doc,
+                doc_len_d=doc_len_d,
+                nnz_total=int(data[f"s{i}_nnz"]), live=live)
+            segs.append(seg)
+            for local, alive in enumerate(live):
+                if alive:
+                    where[names[local]] = (seg, local)
+        nnz = sum(int(e.term_ids.shape[0]) for e in entries)
+        nbytes = sum(e.term_ids.nbytes + e.tfs.nbytes for e in entries)
+        with self._write_lock:
+            if self._pending or self._segments:
+                raise ValueError(
+                    "install_full_state requires an empty index")
+            self._segments = segs
+            self._where = dict(where)
+            self._nnz_live_stat = nnz
+            self._bytes_live_stat = nbytes
+            self._gen += 1
+        global_metrics.inc("docs_indexed", len(entries))
 
     # ---- commit ----
 
-    def _build_segment(self, entries: list[DocEntry],
-                       vocab_cap: int, paced: bool = False) -> Segment:
-        order = np.argsort([-d.term_ids.shape[0] for d in entries],
-                           kind="stable")
-        entries = [entries[i] for i in order]
+    def _layout_host(self, entries: list[DocEntry], vocab_cap: int):
+        """Host-side ELL layout of ``entries`` IN ORDER (no sorting —
+        callers sort; checkpoint export relies on order preservation so
+        a re-layout of ``host_docs`` reproduces the stored name order).
+        Returns ``(ell, df, raw_len, doc_len, doc_cap, nnz)``."""
         n = len(entries)
         sizes = np.fromiter((d.term_ids.shape[0] for d in entries),
                             np.int64, n)
@@ -372,6 +575,16 @@ class SegmentedIndex:
                        nnz=nnz, num_docs=n)
         ell = build_ell_from_coo(coo, width_cap=self.ell_width_cap,
                                  min_rows=min(256, self.min_doc_cap))
+        return ell, df, raw_len, doc_len, doc_cap, nnz
+
+    def _build_segment(self, entries: list[DocEntry],
+                       vocab_cap: int, paced: bool = False) -> Segment:
+        order = np.argsort([-d.term_ids.shape[0] for d in entries],
+                           kind="stable")
+        entries = [entries[i] for i in order]
+        n = len(entries)
+        ell, df, raw_len, doc_len, doc_cap, nnz = self._layout_host(
+            entries, vocab_cap)
         # streaming segments keep raw tf on device (weights are computed
         # per-query with current stats). ``paced`` (background merges):
         # wait for each block's transfer and sleep a multiple of its

@@ -80,6 +80,20 @@ jax.tree_util.register_dataclass(
 )
 
 
+def host_value(x) -> np.ndarray:
+    """Fetch a (small) device array to host, multi-process-safe.
+
+    Single-controller: a plain fetch. Under ``jax.distributed`` a
+    sharded array spans non-addressable devices, so the fetch is a
+    ``process_allgather`` collective — EVERY process must reach this
+    call in the same program order (the SPMD discipline mesh commits
+    already require: all processes ingest and commit identically)."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def _split_ranges(k: int, t_parts: int) -> list[tuple[int, int]]:
     """Contiguous ceil-split of k entries over t_parts terms blocks — the
     single source of truth for the entry partition (build and ingest must
@@ -404,13 +418,13 @@ def build_ingest_batch(mesh: Mesh,
     C = batch_chunk_cap
     doc_cap = arrays.doc_cap
     chunk_cap = arrays.tf.shape[-1]
-    used_now = np.asarray(arrays.nnz_used)
+    used_now = host_value(arrays.nnz_used)
     if int(used_now.max()) + C > chunk_cap:
         raise ValueError(
             f"ingest batch (cap {C}) does not fit free tail "
             f"(used max {int(used_now.max())} of {chunk_cap}); "
             "compact/re-shard with a larger nnz capacity first")
-    n_live_before = [int(x) for x in np.asarray(arrays.n_live)]
+    n_live_before = [int(x) for x in host_value(arrays.n_live)]
     max_new = max((len(d) for d in new_docs_per_shard), default=0)
     L = next_capacity(max(max_new, 1), 8)   # O(batch), not O(doc_cap)
     if max(n_live_before) + L > doc_cap:

@@ -161,8 +161,17 @@ class Config:
     # so restore skips the O(corpus) host re-layout (~6x faster restore
     # at 1M docs). Costs one device->host fetch of the snapshot at save
     # time — cheap on real TPU hosts (PCIe), slow over a remote-TPU
-    # tunnel whose downlink is ~100x thinner than its uplink.
+    # tunnel whose downlink is ~100x thinner than its uplink. (The
+    # segments payload is laid out on host — no device fetch.)
     checkpoint_snapshot_arrays: bool = True
+    # Serving-node checkpoints (the reference persists its index on
+    # every upload, Worker.java:138). Empty path = <index_path>/checkpoint.
+    # interval 0 disables the periodic autosave; /admin/checkpoint
+    # triggers one on demand either way. A serve node restores from the
+    # checkpoint at boot and then re-walks only documents modified after
+    # the save (idempotent upserts keep rebuild-from-documents intact).
+    checkpoint_path: str = ""
+    checkpoint_interval_s: float = 0.0
 
     # --- ingest ---
     # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back

@@ -164,8 +164,10 @@ class TestScatterBatchedLeader:
         """A dead worker's shard drops out of the batched scatter
         (partial results, Leader.java:67-69 / ServiceRegistry watch
         semantics), never an error. Session expiry shrinks the registry,
-        and the scatter client prunes its idle keep-alive socket."""
-        nodes = _mk_cluster(core, tmp_path)
+        and the scatter client prunes its idle keep-alive socket.
+        Recovery is disabled to isolate the scatter layer's tolerance
+        (tests/test_shard_recovery.py covers the re-placement path)."""
+        nodes = _mk_cluster(core, tmp_path, shard_recovery=False)
         try:
             leader = nodes[0]
             for name, data in DOCS.items():

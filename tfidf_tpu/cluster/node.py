@@ -195,7 +195,8 @@ class SearchNode:
         self._coord_factory = coord_factory
         self._stopping = False
         self.engine = engine or Engine(self.config)
-        self.registry = ServiceRegistry(coord)
+        self.registry = ServiceRegistry(
+            coord, on_change=self._on_membership_change)
         self.election = LeaderElection(coord, callback=self)
         coord.on_session_event(self._on_session_event)
         self._pool = ThreadPoolExecutor(
@@ -240,6 +241,19 @@ class SearchNode:
         self._placement: dict[str, str] = {}
         self._claims: dict[str, object] = {}   # in-flight claim tokens
         self._inflight: dict[str, int] = {}    # uploads in flight per name
+        # shard recovery state (all guarded by _placement_lock):
+        # _moved — names re-placed AWAY from a dead worker, keyed by its
+        # URL; the rejoin reconciliation deletes exactly these from it.
+        # Reconciles themselves run one at a time (_reconcile_serial) so
+        # a rejoin cannot interleave with an in-flight recovery.
+        self._moved: dict[str, set[str]] = {}
+        self._reconcile_serial = threading.Lock()
+        # the durable store of placed documents lives BESIDE the served
+        # documents dir, never inside it: the leader's own boot re-walk
+        # must not index copies of documents that live on other workers
+        # (that would double-count them in the scatter sum-merge)
+        self._store_dir = os.path.join(self.config.index_path,
+                                       "placed_docs")
         # guards _placement + _size_cache against concurrent
         # ThreadingHTTPServer upload handlers: without it two
         # simultaneous uploads of the same NEW name can both miss the
@@ -415,7 +429,8 @@ class SearchNode:
             try:
                 coord = self._coord_factory()
                 self.coord = coord
-                self.registry = ServiceRegistry(coord)
+                self.registry = ServiceRegistry(
+                    coord, on_change=self._on_membership_change)
                 self.election = LeaderElection(coord, callback=self)
                 coord.on_session_event(self._on_session_event)
                 self.election.volunteer_for_leadership()
@@ -553,6 +568,170 @@ class SearchNode:
         out = [self._order_merged(m) for m in merged]
         global_metrics.observe("scatter_merge", time.perf_counter() - t0)
         return out
+
+    # ---- shard recovery (SURVEY §5.3 — beyond the reference) ----
+
+    def _store_path(self, name: str) -> str:
+        """Resolve a name under the recovery store with the same
+        traversal check as the engine's documents dir."""
+        base = os.path.abspath(self._store_dir)
+        target = os.path.abspath(os.path.join(base, name))
+        if not (target == base or target.startswith(base + os.sep)):
+            raise PermissionError(f"path escapes store dir: {name!r}")
+        return target
+
+    def _store_document(self, name: str, data: bytes) -> None:
+        """Durable leader-side copy of a placed document (the recovery
+        source; the reference's leader-local disk is already a download
+        source, ``Leader.java:112-121``). Best-effort: a failed store
+        must not fail the upload it shadows."""
+        try:
+            path = self._store_path(name)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.part"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except Exception as e:
+            log.warning("leader document store write failed", file=name,
+                        err=repr(e))
+
+    def _store_read(self, name: str) -> bytes | None:
+        try:
+            path = self._store_path(name)
+            if not os.path.isfile(path):
+                return None
+            with open(path, "rb") as f:
+                return f.read()
+        except Exception:
+            return None
+
+    def _on_membership_change(self, old, new) -> None:
+        """Registry watch hook (watch-dispatch thread — hand off fast)."""
+        if (self._stopping or not self.config.shard_recovery
+                or not self.is_leader()):
+            return
+        lost = set(old) - set(new)
+        joined = set(new) - set(old)
+        if lost or joined:
+            threading.Thread(
+                target=self._reconcile_membership, args=(lost, joined),
+                daemon=True, name=f"shard-recovery-{self.port}").start()
+
+    def _reconcile_membership(self, lost: set[str],
+                              joined: set[str]) -> None:
+        """Re-place a dead worker's documents onto survivors (from the
+        leader's durable store), and delete moved documents from a
+        rejoining worker so the corpus stays single-copy.
+
+        The reference's recovery is pod-restart + re-walk, during which
+        the shard is simply unsearchable (``Worker.java:77-94``,
+        ``ServiceRegistry.java:91-122``); this closes that gap for every
+        document placed during the current leader's tenure.
+
+        Reconciles run ONE AT A TIME (``_reconcile_serial``) in event
+        order, so a rejoin never interleaves with an in-flight recovery;
+        a recovery additionally aborts as soon as the lost worker
+        reappears in the registry (the rejoiner's boot re-walk serves
+        whatever was not yet re-placed), and a name only ever enters
+        ``_moved`` after its confirmed placement is a DIFFERENT worker —
+        deleting the sole copy is impossible by construction."""
+        with self._reconcile_serial:
+            for w in joined:
+                with self._placement_lock:
+                    moved = self._moved.pop(w, None)
+                if not moved:
+                    continue
+                try:
+                    resp = json.loads(http_post(
+                        w + "/worker/delete",
+                        json.dumps({"names": sorted(moved)}).encode(),
+                        timeout=120.0))
+                    log.info("reconciled rejoined worker", worker=w,
+                             deleted=resp.get("deleted", 0))
+                except Exception as e:
+                    # failed reconcile: remember for the next join
+                    with self._placement_lock:
+                        self._moved.setdefault(w, set()).update(moved)
+                    log.warning("rejoin reconciliation failed", worker=w,
+                                err=repr(e))
+            for w in lost:
+                self._recover_lost_worker(w)
+
+    def _recover_lost_worker(self, w: str) -> None:
+        with self._placement_lock:
+            names = [n for n, holder in self._placement.items()
+                     if holder == w]
+        if not names:
+            return
+        log.info("re-placing lost worker's shard", worker=w,
+                 docs=len(names))
+        replaced = 0
+        batch: list[dict] = []
+        aborted = False
+        for name in names:
+            if w in self.registry.get_all_service_addresses():
+                # the worker came back mid-recovery: stop — its boot
+                # re-walk serves everything not yet re-placed, and the
+                # rejoin reconcile (queued behind this one) deletes
+                # what was
+                aborted = True
+                break
+            data = self._store_read(name)
+            if data is None:
+                continue   # placed before this leader's tenure
+            try:
+                text = data.decode("utf-8")
+                batch.append({"name": name, "text": text})
+                if len(batch) >= 500:
+                    replaced += self._replace_batch(batch, w)
+                    batch = []
+                continue
+            except UnicodeDecodeError:
+                pass
+            try:   # non-UTF-8 (binary-extractable) docs: per-file
+                self.leader_upload(name, data)
+                replaced += self._note_moved([name], w)
+            except Exception as e:
+                log.warning("re-placement failed", file=name,
+                            err=repr(e))
+        if batch:
+            replaced += self._replace_batch(batch, w)
+        global_metrics.inc("shard_recoveries")
+        global_metrics.inc("shard_docs_replaced", replaced)
+        log.info("shard recovery complete", worker=w, replaced=replaced,
+                 known=len(names), aborted=aborted)
+
+    def _note_moved(self, names: list[str], old_worker: str) -> int:
+        """Record names as moved away from ``old_worker`` — only those
+        whose CONFIRMED placement is now a different worker (a doc the
+        upload routed back onto a just-rejoined ``old_worker`` must not
+        be scheduled for deletion from it)."""
+        n = 0
+        with self._placement_lock:
+            moved = self._moved.setdefault(old_worker, set())
+            for name in names:
+                holder = self._placement.get(name)
+                if holder is not None and holder != old_worker:
+                    moved.add(name)
+                    n += 1
+        return n
+
+    def _replace_batch(self, docs: list[dict], old_worker: str) -> int:
+        try:
+            resp = self.leader_upload_batch(docs)
+        except Exception as e:
+            log.warning("re-placement batch failed", err=repr(e),
+                        docs=len(docs))
+            return 0
+        # only names a worker ACCEPTED count as moved: 'skipped' are
+        # media-type rejections, 'failed' are transport-errored groups
+        # that were never indexed anywhere
+        not_placed = {s["name"] for s in resp.get("skipped", ())}
+        not_placed.update(resp.get("failed", ()))
+        return self._note_moved(
+            [d["name"] for d in docs if d["name"] not in not_placed],
+            old_worker)
 
     # size polls are cached this long; between polls the leader grows
     # its local estimates by the bytes it placed, so bursts still spread
@@ -744,6 +923,8 @@ class SearchNode:
         with self._placement_lock:
             self._settle_success(filename, chosen, len(data))
             sizes = dict(self._size_cache[1])
+        if self.config.shard_recovery:
+            self._store_document(filename, data)
         global_metrics.inc("uploads_placed")
         # the worker may be absent from the size cache (held-route after
         # an eviction skips the freshness poll) — never KeyError a
@@ -801,6 +982,7 @@ class SearchNode:
         placed = {}
         errors = {}
         skipped: list[dict] = []
+        failed: list[str] = []   # names in transport-errored groups
         for w, group in per_worker.items():
             try:
                 resp = json.loads(http_post(
@@ -808,6 +990,7 @@ class SearchNode:
                     json.dumps(group).encode(), timeout=300.0))
             except Exception as e:
                 errors[w] = repr(e)
+                failed.extend(d["name"] for d in group)
                 app_reject = (isinstance(e, urllib.error.HTTPError)
                               and e.code < 500)
                 with self._placement_lock:
@@ -834,6 +1017,11 @@ class SearchNode:
                         continue
                     self._settle_success(name, w,
                                          len(d.get("text", "")))
+            if self.config.shard_recovery:
+                for d in group:
+                    if d["name"] not in w_skipped:
+                        self._store_document(
+                            d["name"], d.get("text", "").encode("utf-8"))
             global_metrics.inc("uploads_placed", placed[w])
         if errors and not placed:
             raise RuntimeError(f"all workers failed: {errors}")
@@ -842,6 +1030,7 @@ class SearchNode:
             out["skipped"] = skipped
         if errors:
             out["errors"] = errors
+            out["failed"] = failed
         return out
 
     def leader_download_stream(self, rel: str):
@@ -856,6 +1045,14 @@ class SearchNode:
         local = self.engine.open_document_stream(rel)
         if local is not None:
             return local
+        try:   # the leader's durable recovery store is a local source too
+            path = self._store_path(rel)
+            if os.path.isfile(path):
+                return open(path, "rb"), os.path.getsize(path)
+        except PermissionError:
+            raise
+        except Exception:
+            pass
         q = urllib.parse.quote(rel)
         for w in self.registry.get_all_service_addresses():
             try:
@@ -1061,6 +1258,20 @@ class _NodeHandler(BaseHTTPRequestHandler):
                         node.notify_write()
                 self._json({"indexed": len(docs) - len(skipped),
                             "skipped": skipped})
+            elif u.path == "/worker/delete":
+                # shard-recovery reconciliation: remove moved documents
+                # from index AND disk (a boot re-walk must not resurrect
+                # them). Framework addition — the reference cannot move
+                # documents between workers at all.
+                names = json.loads(self._body().decode("utf-8"))
+                names = names.get("names", []) if isinstance(names, dict) \
+                    else names
+                removed = sum(
+                    bool(node.engine.remove_document(str(n)))
+                    for n in names)
+                if removed:
+                    node.notify_write()
+                self._json({"deleted": removed})
             elif u.path == "/admin/checkpoint":
                 # on-demand durability point (reference analog: the
                 # per-upload indexWriter.commit(), Worker.java:138)

@@ -29,11 +29,23 @@ LEADER_INFO = "/leader_info"
 
 
 class ServiceRegistry:
-    def __init__(self, coord) -> None:
+    def __init__(self, coord, on_change=None) -> None:
+        """``on_change(old_addrs, new_addrs)`` fires after every
+        membership-cache refresh that changed the set — the leader's
+        shard-recovery hook (framework addition; the reference's cache
+        refresh is silent, ``ServiceRegistry.java:91-111``). Called on
+        the watch-dispatch thread: implementations must not block."""
         self.coord = coord
         self._znode: str | None = None
         self._addresses: tuple[str, ...] | None = None
+        self._on_membership = on_change
         self._lock = threading.Lock()
+        # serializes hook delivery and anchors each notification's "old"
+        # to the previously NOTIFIED state — two concurrent refreshes
+        # must not deliver transitions out of order (a stale A->B after
+        # B->C would tell the leader a live worker was lost)
+        self._notify_lock = threading.Lock()
+        self._last_notified: tuple[str, ...] | None = None
         self.coord.ensure(REGISTRY_NAMESPACE)   # (:35-51)
 
     # ``registerToCluster`` (:54-64)
@@ -83,8 +95,21 @@ class ServiceRegistry:
                 except NoNodeError:
                     continue   # vanished between listing and read (:99-103)
                 addrs.append(data.decode())
+            first = self._addresses is None
             self._addresses = tuple(addrs)
             log.info("cluster addresses updated", addresses=addrs)
+        if self._on_membership is None:
+            return
+        with self._notify_lock:
+            with self._lock:
+                cur = self._addresses
+            old = self._last_notified
+            self._last_notified = cur
+            if first and old is None:
+                return   # initial population is not a transition
+            if old is not None and set(old) != set(cur):
+                # outside self._lock: the hook may consult the registry
+                self._on_membership(old, cur)
 
     # ``process(WatchedEvent)`` (:113-122). The one-shot watch was consumed
     # when this fired, so a failed refresh MUST be retried — otherwise the

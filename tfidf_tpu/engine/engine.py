@@ -196,6 +196,20 @@ class Engine:
         with self._write_lock:
             return self.index.delete_document(name)
 
+    def remove_document(self, rel: str) -> bool:
+        """Delete a document from BOTH the index and the durable docs
+        dir — the shard-recovery reconciliation needs both, or a
+        restarted worker's boot re-walk resurrects the moved doc."""
+        with self._write_lock:
+            ok = self.index.delete_document(rel)
+            try:
+                path = self._safe_doc_path(rel)
+                if os.path.isfile(path):
+                    os.unlink(path)
+            except PermissionError:
+                pass   # traversal-unsafe name cannot exist on disk
+            return ok
+
     def commit(self) -> None:
         with self._write_lock, trace_phase("commit"), Stopwatch() as sw:
             self.index.commit(self.vocab.capacity())

@@ -173,6 +173,17 @@ class Config:
     checkpoint_path: str = ""
     checkpoint_interval_s: float = 0.0
 
+    # --- shard recovery (SURVEY §5.3 — capability the reference lacks) ---
+    # The leader keeps a durable copy of every document it places (its
+    # own documents dir; the reference's leader-local disk is already a
+    # download source, Leader.java:112-121) and, when a worker drops out
+    # of the registry, re-places that worker's documents onto survivors
+    # so the full corpus stays searchable. When the dead worker rejoins
+    # (same URL), the leader reconciles by deleting the moved documents
+    # from it. Scope: documents placed during the current leader's
+    # tenure (a freshly promoted leader starts with an empty store).
+    shard_recovery: bool = True
+
     # --- ingest ---
     # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back
     # to the pure-Python analyzer when no compiler is available or for

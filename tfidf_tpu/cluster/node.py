@@ -438,6 +438,22 @@ class SearchNode:
                 global_metrics.inc("session_rejoins")
                 log.info("rejoined cluster after session expiry",
                          url=self.url, leader=self.election.is_leader())
+                # the rebuilt registry's first refresh is "initial
+                # population", never a lost-transition — so a worker
+                # that died DURING the outage would stay dark forever.
+                # Diff the placement map against the fresh view here.
+                if (self.config.shard_recovery
+                        and self.election.is_leader()):
+                    live = set(
+                        self.registry.get_all_service_addresses())
+                    with self._placement_lock:
+                        known = set(self._placement.values())
+                    lost = known - live
+                    if lost:
+                        threading.Thread(
+                            target=self._reconcile_membership,
+                            args=(lost, set()), daemon=True,
+                            name=f"shard-recovery-{self.port}").start()
                 return
             except Exception as e:
                 log.warning("rejoin attempt failed", err=repr(e))
@@ -667,6 +683,7 @@ class SearchNode:
         log.info("re-placing lost worker's shard", worker=w,
                  docs=len(names))
         replaced = 0
+        missing = 0
         batch: list[dict] = []
         aborted = False
         for name in names:
@@ -679,7 +696,11 @@ class SearchNode:
                 break
             data = self._store_read(name)
             if data is None:
-                continue   # placed before this leader's tenure
+                # placed before this leader's tenure (or its store write
+                # failed) — count and surface: these stay dark until the
+                # pod restarts, exactly the reference's behavior
+                missing += 1
+                continue
             try:
                 text = data.decode("utf-8")
                 batch.append({"name": name, "text": text})
@@ -699,8 +720,13 @@ class SearchNode:
             replaced += self._replace_batch(batch, w)
         global_metrics.inc("shard_recoveries")
         global_metrics.inc("shard_docs_replaced", replaced)
+        if missing:
+            global_metrics.inc("shard_docs_unrecovered", missing)
+            log.warning("shard recovery left documents dark (no durable "
+                        "copy; placed before this leader's tenure)",
+                        worker=w, unrecovered=missing)
         log.info("shard recovery complete", worker=w, replaced=replaced,
-                 known=len(names), aborted=aborted)
+                 known=len(names), missing=missing, aborted=aborted)
 
     def _note_moved(self, names: list[str], old_worker: str) -> int:
         """Record names as moved away from ``old_worker`` — only those

@@ -105,9 +105,13 @@ class QueryVectorizerMixin:
         pending: deque = deque()
         out: list = []
         for chunk in chunks:
-            pending.append(dispatch(chunk))
-            if len(pending) > depth:
+            # drain BEFORE dispatching so at most ``depth`` chunks are
+            # in flight including the new one — dispatch-then-drain kept
+            # depth+1 buffers live, quietly shrinking the HBM headroom
+            # the probes derive from the documented depth (ADVICE r4)
+            while len(pending) >= depth:
                 out.extend(finish(*pending.popleft()))
+            pending.append(dispatch(chunk))
         while pending:
             out.extend(finish(*pending.popleft()))
         return out

@@ -240,13 +240,17 @@ def _collect_tounicode(data: bytes, streams: list[bytes]
     return merged
 
 
-def _decode_cids(raw: bytes, cmaps: dict[int, dict[int, str]]
-                 ) -> str | None:
+def _decode_cids(raw: bytes, cmaps: dict[int, dict[int, str]],
+                 min_coverage: float = 0.8) -> str | None:
     """Decode show-string bytes as CID codes through the ToUnicode
     maps, trying each code width (widest first — a 2-byte string rarely
     decodes >=80% through a 1-byte map by accident, but prefer the
-    stricter interpretation). Returns None unless most codes map —
-    emitting unmapped glyph ids would index noise."""
+    stricter interpretation). Returns None unless at least
+    ``min_coverage`` of the codes map — emitting unmapped glyph ids
+    would index noise. Literal-string callers pass 1.0: a subsetted
+    simple font's PARTIAL 1-byte ToUnicode must not override a latin-1
+    string it only mostly covers (ADVICE r4 — Tika tracks the active
+    font per Tf; without that, full coverage is the safe gate)."""
     if not cmaps or not raw:
         return None
     for code_len in sorted(cmaps, reverse=True):
@@ -257,7 +261,7 @@ def _decode_cids(raw: bytes, cmaps: dict[int, dict[int, str]]
         codes = [int.from_bytes(raw[i * code_len:(i + 1) * code_len],
                                 "big") for i in range(n)]
         hits = [cmap[c] for c in codes if c in cmap]
-        if len(hits) >= max(1, int(0.8 * n)):
+        if len(hits) >= max(1, int(min_coverage * n)):
             return "".join(hits)
     return None
 
@@ -278,7 +282,10 @@ def _extract_pdf(data: bytes) -> str:
     cmaps = _collect_tounicode(data, streams)
 
     def show(raw_bytes: bytes) -> str:
-        cid = _decode_cids(raw_bytes, cmaps)
+        # literal strings demand FULL CMap coverage before the document
+        # CMap may override latin-1 (hex show-strings keep the 80%
+        # threshold below — they cannot be latin-1 text)
+        cid = _decode_cids(raw_bytes, cmaps, min_coverage=1.0)
         if cid is not None:
             return cid
         return raw_bytes.decode("latin-1")
@@ -439,6 +446,149 @@ def _extract_rtf(data: bytes) -> str:
             .decode("utf-16-le", "ignore"))
 
 
+def _cfb_streams(data: bytes) -> dict[str, bytes]:
+    """Minimal [MS-CFB] (OLE2 compound file) reader: returns the
+    top-level stream name -> bytes map. Supports the regular FAT chain,
+    the DIFAT extension, and the mini stream (streams under the 4096-
+    byte cutoff live in 64-byte mini sectors inside the root entry's
+    chain) — the containers Word 97-2003 actually produces."""
+    import struct as st
+
+    if data[:8] != b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1":
+        raise ValueError("not an OLE2 compound file")
+    sec_shift = st.unpack_from("<H", data, 30)[0]
+    mini_shift = st.unpack_from("<H", data, 32)[0]
+    sec = 1 << sec_shift
+    mini_sec = 1 << mini_shift
+    n_fat = st.unpack_from("<I", data, 44)[0]
+    dir_start = st.unpack_from("<I", data, 48)[0]
+    mini_cutoff = st.unpack_from("<I", data, 56)[0]
+    minifat_start = st.unpack_from("<I", data, 60)[0]
+    difat_start = st.unpack_from("<I", data, 68)[0]
+    n_difat = st.unpack_from("<I", data, 72)[0]
+
+    def sector(i: int) -> bytes:
+        off = (i + 1) * sec
+        return data[off:off + sec]
+
+    # FAT sector list: 109 header DIFAT entries + chained DIFAT sectors
+    fat_sectors = list(st.unpack_from("<109I", data, 76))
+    s = difat_start
+    for _ in range(n_difat):
+        if s in (0xFFFFFFFE, 0xFFFFFFFF):
+            break
+        blk = sector(s)
+        more = st.unpack(f"<{sec // 4}I", blk)
+        fat_sectors.extend(more[:-1])
+        s = more[-1]
+    fat_sectors = [x for x in fat_sectors[:max(n_fat, 0) or None]
+                   if x not in (0xFFFFFFFE, 0xFFFFFFFF)]
+    fat: list[int] = []
+    for fs in fat_sectors:
+        fat.extend(st.unpack(f"<{sec // 4}I", sector(fs)))
+
+    def chain(start: int) -> bytes:
+        out, s, seen = [], start, set()
+        while s not in (0xFFFFFFFE, 0xFFFFFFFF) and s < len(fat):
+            if s in seen:
+                break   # corrupt cycle; stop rather than loop forever
+            seen.add(s)
+            out.append(sector(s))
+            s = fat[s]
+        return b"".join(out)
+
+    directory = chain(dir_start)
+    # mini FAT + the mini stream (root entry's chain)
+    minifat: list[int] = []
+    if minifat_start not in (0xFFFFFFFE, 0xFFFFFFFF):
+        mf = chain(minifat_start)
+        minifat = list(st.unpack(f"<{len(mf) // 4}I", mf))
+    root_start = st.unpack_from("<I", directory, 116)[0]
+    mini_data = chain(root_start)
+
+    def mini_chain(start: int) -> bytes:
+        out, s, seen = [], start, set()
+        while s not in (0xFFFFFFFE, 0xFFFFFFFF) and s < len(minifat):
+            if s in seen:
+                break
+            seen.add(s)
+            out.append(mini_data[s * mini_sec:(s + 1) * mini_sec])
+            s = minifat[s]
+        return b"".join(out)
+
+    streams: dict[str, bytes] = {}
+    for off in range(0, len(directory) - 127, 128):
+        entry = directory[off:off + 128]
+        name_len = st.unpack_from("<H", entry, 64)[0]
+        etype = entry[66]
+        if etype != 2 or name_len < 2:   # streams only
+            continue
+        name = entry[:name_len - 2].decode("utf-16-le", "ignore")
+        start = st.unpack_from("<I", entry, 116)[0]
+        size = st.unpack_from("<Q", entry, 120)[0]
+        raw = (mini_chain(start) if size < mini_cutoff
+               else chain(start))
+        streams[name] = raw[:size]
+    return streams
+
+
+def _extract_doc(data: bytes) -> str:
+    """Legacy Word 97-2003 ``.doc`` text ([MS-DOC]): locate the piece
+    table (CLX) in the Table stream via the FIB, then pull each piece's
+    text from the WordDocument stream — cp1252 for compressed pieces,
+    UTF-16LE otherwise. The last common Tika format the reference's
+    ``AutoDetectParser`` handles (``Worker.java:198-212``) that
+    previously 415'd here."""
+    streams = _cfb_streams(data)
+    word = streams.get("WordDocument")
+    if word is None or len(word) < 0x200:
+        raise UnsupportedMediaType("OLE2 container without a "
+                                   "WordDocument stream")
+    import struct as st
+    if st.unpack_from("<H", word, 0)[0] != 0xA5EC:
+        raise UnsupportedMediaType("WordDocument stream without FIB")
+    flags = st.unpack_from("<H", word, 0x000A)[0]
+    if flags & 0x0100:   # fEncrypted: piece text is RC4/XOR ciphertext
+        raise UnsupportedMediaType("encrypted .doc")
+    table = streams.get("1Table" if flags & 0x0200 else "0Table")
+    if table is None:
+        table = streams.get("1Table") or streams.get("0Table")
+    fc_clx = st.unpack_from("<I", word, 0x01A2)[0]
+    lcb_clx = st.unpack_from("<I", word, 0x01A6)[0]
+    if table is None or lcb_clx == 0 or fc_clx + lcb_clx > len(table):
+        raise UnsupportedMediaType(".doc without a readable piece table")
+    clx = table[fc_clx:fc_clx + lcb_clx]
+    pos = 0
+    while pos < len(clx) and clx[pos] == 0x01:   # Prc (grpprl) blocks
+        cb = st.unpack_from("<H", clx, pos + 1)[0]
+        pos += 3 + cb
+    if pos >= len(clx) or clx[pos] != 0x02:
+        raise UnsupportedMediaType(".doc piece table not found in CLX")
+    lcb = st.unpack_from("<I", clx, pos + 1)[0]
+    plc = clx[pos + 5:pos + 5 + lcb]
+    n = (len(plc) - 4) // 12
+    if n <= 0:
+        raise UnsupportedMediaType(".doc with an empty piece table")
+    cps = st.unpack(f"<{n + 1}I", plc[:4 * (n + 1)])
+    out: list[str] = []
+    for i in range(n):
+        pcd = plc[4 * (n + 1) + 8 * i:4 * (n + 1) + 8 * (i + 1)]
+        fc = st.unpack_from("<I", pcd, 2)[0]
+        n_cp = cps[i + 1] - cps[i]
+        if fc & 0x40000000:   # compressed: cp1252, one byte per cp
+            off = (fc & 0x3FFFFFFF) // 2
+            out.append(word[off:off + n_cp].decode("cp1252", "replace"))
+        else:
+            off = fc & 0x3FFFFFFF
+            out.append(word[off:off + 2 * n_cp]
+                       .decode("utf-16-le", "replace"))
+    text = "".join(out)
+    # Word control characters: paragraph/cell marks, field delimiters
+    text = (text.replace("\r", "\n").replace("\x07", "\n")
+            .replace("\x0b", "\n"))
+    return re.sub(r"[\x00-\x08\x0c-\x1f\x13\x14\x15]", " ", text)
+
+
 def _extract_html(text: str) -> str:
     """Strip tags/scripts/styles, unescape entities."""
     import html
@@ -474,6 +624,19 @@ def extract_text(data: bytes) -> str:
         text = _extract_rtf(data)
         if not text.strip():
             raise UnsupportedMediaType("RTF with no extractable text")
+        return text
+    if data[:8] == b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1":
+        # OLE2 compound file: Word 97-2003 .doc extracts; other OLE2
+        # payloads (.xls/.ppt/.msg) refuse with a typed 415
+        try:
+            text = _extract_doc(data)
+        except UnsupportedMediaType:
+            raise
+        except Exception as e:
+            raise UnsupportedMediaType(
+                f"unreadable OLE2 document ({type(e).__name__})")
+        if not text.strip():
+            raise UnsupportedMediaType(".doc with no extractable text")
         return text
     if data[:4] == b"PK\x03\x04":
         text = None

@@ -95,8 +95,16 @@ def main():
     cm_merge = np.asarray([m for m, f in steady if f] or [0.0])
     cm_alone = np.asarray([m for m, f in steady if not f] or [0.0])
     queries = make_queries(rng, NS_VOCAB, 32)
-    hits = engine.search_batch(queries, k=10)
-    assert any(hits), "index must answer queries at full scale"
+    try:
+        hits = engine.search_batch(queries, k=10)
+        search_ok = bool(any(hits))
+    except Exception as e:
+        # the tunnel's remote-compile service flakes occasionally
+        # (HTTP 500 from tpu_compile_helper); the ingest/commit stats
+        # above are the point of this probe — record the failure
+        # instead of losing the whole run to it
+        log(f"[st] full-scale search failed: {e!r}")
+        search_ok = False
     from tfidf_tpu.utils.metrics import global_metrics
     snap = global_metrics.snapshot()
     out = {
@@ -123,11 +131,15 @@ def main():
         "quiesce_s": round(quiesce_s, 1),
         "segments": len(engine.index.snapshot.segments),
         "nnz_live": int(engine.index.nnz_live),
+        "search_ok": search_ok,
     }
     log(f"[done] {json.dumps(out)}")
-    with open(os.path.join(os.path.dirname(__file__),
-                           "MSMARCO_SCALE.json"), "w") as f:
-        json.dump(out, f, indent=1)
+    if N_DOCS >= 8_000_000:
+        # only FULL runs update the committed artifact (bracketing runs
+        # at smaller N_DOCS print their JSON for the caller to merge)
+        with open(os.path.join(os.path.dirname(__file__),
+                               "MSMARCO_SCALE.json"), "w") as f:
+            json.dump(out, f, indent=1)
     print(json.dumps(out))
 
 

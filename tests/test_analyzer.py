@@ -476,22 +476,25 @@ def _make_cfb_doc(pieces):
     for i in range(n_mini):
         minifat[i] = i + 1 if i < n_mini - 1 else 0xFFFFFFFE
 
-    def dirent(name, etype, start, size):
+    def dirent(name, etype, start, size, left=-1, right=-1, child=-1):
         e = bytearray(128)
         nm = name.encode("utf-16-le")
         e[:len(nm)] = nm
         st.pack_into("<H", e, 64, len(nm) + 2)
         e[66] = etype
         e[67] = 1                              # black (valid color)
-        st.pack_into("<i", e, 68, -1)          # left sibling
-        st.pack_into("<i", e, 72, -1)          # right sibling
-        st.pack_into("<i", e, 76, 1 if etype == 5 else -1)   # child
+        st.pack_into("<i", e, 68, left)
+        st.pack_into("<i", e, 72, right)
+        st.pack_into("<i", e, 76, child)
         st.pack_into("<I", e, 116, start)
         st.pack_into("<Q", e, 120, size)
         return bytes(e)
 
-    directory = (dirent("Root Entry", 5, 3 + n_word_sec, len(mini))
-                 + dirent("WordDocument", 2, 3, len(word))
+    # root's child tree: WordDocument (entry 1) with 1Table (entry 2)
+    # as its right sibling — readers walk the root child tree only
+    directory = (dirent("Root Entry", 5, 3 + n_word_sec, len(mini),
+                        child=1)
+                 + dirent("WordDocument", 2, 3, len(word), right=2)
                  + dirent("1Table", 2, 0, len(table))
                  + bytes(128))
 

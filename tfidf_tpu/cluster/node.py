@@ -441,24 +441,32 @@ class SearchNode:
                 # the rebuilt registry's first refresh is "initial
                 # population", never a lost-transition — so a worker
                 # that died DURING the outage would stay dark forever.
-                # Diff the placement map against the fresh view here.
+                # Diff the placement map against the fresh view, after
+                # a grace period: a registry-wide blip expires EVERY
+                # session, and diffing before the other workers finish
+                # their own rejoins would re-place the whole corpus
+                # only to reconcile it back seconds later.
                 if (self.config.shard_recovery
                         and self.election.is_leader()):
-                    live = set(
-                        self.registry.get_all_service_addresses())
-                    with self._placement_lock:
-                        known = set(self._placement.values())
-                    lost = known - live
-                    if lost:
-                        threading.Thread(
-                            target=self._reconcile_membership,
-                            args=(lost, set()), daemon=True,
-                            name=f"shard-recovery-{self.port}").start()
+                    threading.Thread(
+                        target=self._recover_after_rejoin, daemon=True,
+                        name=f"shard-recovery-{self.port}").start()
                 return
             except Exception as e:
                 log.warning("rejoin attempt failed", err=repr(e))
                 time.sleep(delay)
                 delay = min(delay * 2, 5.0)
+
+    def _recover_after_rejoin(self) -> None:
+        time.sleep(max(2 * self.config.session_timeout_s, 1.0))
+        if self._stopping or not self.is_leader():
+            return
+        live = set(self.registry.get_all_service_addresses())
+        with self._placement_lock:
+            known = set(self._placement.values())
+        lost = known - live
+        if lost:
+            self._reconcile_membership(lost, set())
 
     # ---- role transitions (leader/OnElectionAction.java:27-77) ----
 

@@ -183,11 +183,8 @@ def load_checkpoint(directory: str, config: Config | None = None) -> Engine:
             and snap_meta_pre.get("score_signature")
             == _score_signature(engine)):
         from tfidf_tpu.engine.index import entries_from_packed
-        entries = entries_from_packed(
-            names, np.ascontiguousarray(offsets, np.int64),
-            np.ascontiguousarray(term_ids, np.int32),
-            np.ascontiguousarray(tfs, np.float32),
-            np.ascontiguousarray(lengths, np.float32))
+        entries, _arrays = entries_from_packed(names, offsets, term_ids,
+                                               tfs, lengths)
         engine.index.install_full_state(np.load(seg_path), entries)
         engine.commit()
         log.info("checkpoint loaded", dir=directory, docs=len(names),

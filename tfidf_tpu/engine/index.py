@@ -52,16 +52,23 @@ class DocEntry:
 
 def entries_from_packed(names: list[str], offsets: np.ndarray,
                         term_ids: np.ndarray, tfs: np.ndarray,
-                        lengths: np.ndarray) -> list["DocEntry"]:
+                        lengths: np.ndarray):
     """Doc-table construction from packed CSR-style checkpoint arrays
     with per-doc numpy VIEWS (no copies, no per-document ingest work) —
-    shared by every index kind's bulk-restore path."""
+    shared by every index kind's bulk-restore path. Coerces dtypes once
+    and returns ``(entries, (offsets, term_ids, tfs, lengths))`` with
+    the coerced arrays (the entries are views into THESE)."""
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    term_ids = np.ascontiguousarray(term_ids, np.int32)
+    tfs = np.ascontiguousarray(tfs, np.float32)
+    lengths = np.ascontiguousarray(lengths, np.float32)
     lo = offsets[:-1].tolist()
     hi = offsets[1:].tolist()
     lens = lengths.tolist()
-    return [DocEntry(name=names[i], term_ids=term_ids[lo[i]:hi[i]],
-                     tfs=tfs[lo[i]:hi[i]], length=lens[i])
-            for i in range(len(names))]
+    entries = [DocEntry(name=names[i], term_ids=term_ids[lo[i]:hi[i]],
+                        tfs=tfs[lo[i]:hi[i]], length=lens[i])
+               for i in range(len(names))]
+    return entries, (offsets, term_ids, tfs, lengths)
 
 
 @dataclass
@@ -196,16 +203,13 @@ class ShardIndex:
         fully vectorized too (no 1M-array concatenate). Only valid on an
         empty index; later upserts/deletes work normally (they drop the
         vectorized-commit fast path, not correctness)."""
-        offsets = np.ascontiguousarray(offsets, np.int64)
-        term_ids = np.ascontiguousarray(term_ids, np.int32)
-        tfs = np.ascontiguousarray(tfs, np.float32)
-        lengths = np.ascontiguousarray(lengths, np.float32)
+        entries, (offsets, term_ids, tfs, lengths) = \
+            entries_from_packed(names, offsets, term_ids, tfs, lengths)
         n = len(names)
         with self._write_lock:
             if self._docs:
                 raise ValueError("bulk_load_packed requires an empty index")
-            self._docs = entries_from_packed(names, offsets, term_ids,
-                                             tfs, lengths)
+            self._docs = entries
             self._by_name = dict(zip(names, range(n)))
             if len(self._by_name) != n:
                 self._docs, self._by_name = [], {}

@@ -136,10 +136,11 @@ class Searcher(QueryVectorizerMixin):
         self.result_order = result_order
         self.use_pallas = use_pallas
         # in-flight chunks: on small corpora the device step is far
-        # shorter than the device->host fetch RTT, so one-deep
-        # pipelining caps throughput at ~1 chunk per RTT; depth D keeps
-        # D fetches in flight (each pending chunk holds only a packed
-        # [B, 2k] top-k buffer)
+        # shorter than the device->host fetch RTT, so serial execution
+        # caps throughput at ~1 chunk per RTT; depth D keeps D chunks
+        # in flight INCLUDING the one just dispatched (so D-1 fetches
+        # overlap the newest chunk's compute; each pending chunk holds
+        # only a packed [B, 2k] top-k buffer)
         self.pipeline_depth = max(1, pipeline_depth)
 
     def _batch_cap(self, n: int) -> int:

@@ -363,12 +363,8 @@ class SegmentedIndex:
         ONE segment from it. ``install_full_state`` is the faster path
         that also skips that commit's O(corpus) layout."""
         from tfidf_tpu.engine.index import entries_from_packed
-        offsets = np.ascontiguousarray(offsets, np.int64)
-        term_ids = np.ascontiguousarray(term_ids, np.int32)
-        tfs = np.ascontiguousarray(tfs, np.float32)
-        lengths = np.ascontiguousarray(lengths, np.float32)
-        entries = entries_from_packed(names, offsets, term_ids, tfs,
-                                      lengths)
+        entries, (offsets, term_ids, tfs, lengths) = \
+            entries_from_packed(names, offsets, term_ids, tfs, lengths)
         n = len(names)
         with self._write_lock:
             if self._pending or self._segments:

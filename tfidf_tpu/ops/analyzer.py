@@ -241,16 +241,18 @@ def _collect_tounicode(data: bytes, streams: list[bytes]
 
 
 def _decode_cids(raw: bytes, cmaps: dict[int, dict[int, str]],
-                 min_coverage: float = 0.8) -> str | None:
+                 strict_single_byte: bool = False) -> str | None:
     """Decode show-string bytes as CID codes through the ToUnicode
     maps, trying each code width (widest first — a 2-byte string rarely
     decodes >=80% through a 1-byte map by accident, but prefer the
-    stricter interpretation). Returns None unless at least
-    ``min_coverage`` of the codes map — emitting unmapped glyph ids
-    would index noise. Literal-string callers pass 1.0: a subsetted
-    simple font's PARTIAL 1-byte ToUnicode must not override a latin-1
-    string it only mostly covers (ADVICE r4 — Tika tracks the active
-    font per Tf; without that, full coverage is the safe gate)."""
+    stricter interpretation). Returns None unless enough codes map —
+    emitting unmapped glyph ids would index noise. Literal-string
+    callers pass ``strict_single_byte``: a subsetted simple font's
+    PARTIAL 1-byte ToUnicode must not override a latin-1 string it only
+    mostly covers (ADVICE r4 — Tika tracks the active font per Tf;
+    without that, full 1-byte coverage is the safe gate). Multi-byte
+    maps keep the 80% threshold even for literal strings — their bytes
+    cannot be latin-1 text, so a partial decode beats mojibake."""
     if not cmaps or not raw:
         return None
     for code_len in sorted(cmaps, reverse=True):
@@ -261,7 +263,8 @@ def _decode_cids(raw: bytes, cmaps: dict[int, dict[int, str]],
         codes = [int.from_bytes(raw[i * code_len:(i + 1) * code_len],
                                 "big") for i in range(n)]
         hits = [cmap[c] for c in codes if c in cmap]
-        if len(hits) >= max(1, int(min_coverage * n)):
+        need = (1.0 if (strict_single_byte and code_len == 1) else 0.8)
+        if len(hits) >= max(1, int(need * n)):
             return "".join(hits)
     return None
 
@@ -282,10 +285,11 @@ def _extract_pdf(data: bytes) -> str:
     cmaps = _collect_tounicode(data, streams)
 
     def show(raw_bytes: bytes) -> str:
-        # literal strings demand FULL CMap coverage before the document
-        # CMap may override latin-1 (hex show-strings keep the 80%
-        # threshold below — they cannot be latin-1 text)
-        cid = _decode_cids(raw_bytes, cmaps, min_coverage=1.0)
+        # literal strings demand FULL 1-byte-CMap coverage before the
+        # document CMap may override latin-1 (hex show-strings and
+        # multi-byte maps keep the 80% threshold — their bytes cannot
+        # be latin-1 text)
+        cid = _decode_cids(raw_bytes, cmaps, strict_single_byte=True)
         if cid is not None:
             return cid
         return raw_bytes.decode("latin-1")
@@ -516,13 +520,31 @@ def _cfb_streams(data: bytes) -> dict[str, bytes]:
             s = minifat[s]
         return b"".join(out)
 
+    # walk only the ROOT storage's child tree: a sub-storage (e.g. an
+    # embedded OLE object in ObjectPool) may contain its own
+    # WordDocument/1Table pair, and a flat scan would let it shadow the
+    # actual document body
+    n_entries = len(directory) // 128
+
+    def entry_at(i: int) -> bytes:
+        return directory[i * 128:(i + 1) * 128]
+
     streams: dict[str, bytes] = {}
-    for off in range(0, len(directory) - 127, 128):
-        entry = directory[off:off + 128]
+    root_child = st.unpack_from("<i", entry_at(0), 76)[0]
+    stack = [root_child]
+    seen_ids: set[int] = set()
+    while stack:
+        i = stack.pop()
+        if i < 0 or i >= n_entries or i in seen_ids:
+            continue
+        seen_ids.add(i)
+        entry = entry_at(i)
+        stack.append(st.unpack_from("<i", entry, 68)[0])   # left sib
+        stack.append(st.unpack_from("<i", entry, 72)[0])   # right sib
         name_len = st.unpack_from("<H", entry, 64)[0]
         etype = entry[66]
-        if etype != 2 or name_len < 2:   # streams only
-            continue
+        if etype != 2 or name_len < 2:   # root-level streams only;
+            continue                     # storages are NOT descended
         name = entry[:name_len - 2].decode("utf-16-le", "ignore")
         start = st.unpack_from("<I", entry, 116)[0]
         size = st.unpack_from("<Q", entry, 120)[0]

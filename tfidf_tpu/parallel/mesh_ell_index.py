@@ -153,6 +153,20 @@ class MeshEllIndex(MeshIndex):
             self._gen += 1
         global_metrics.inc("docs_indexed")
 
+    def _bulk_load_stats(self, term_ids, lengths) -> None:
+        # vectorized resync: one bincount instead of a per-doc
+        # _stat_add loop (the very loop bulk_load_packed removes). The
+        # first commit takes the rebuild path (no base yet) and
+        # re-syncs from the authoritative postings regardless; the
+        # single journal entry keeps the invariant for safety.
+        ids = term_ids.astype(np.int64)
+        hi = int(ids.max()) + 1 if ids.size else 1
+        self._df_live = np.bincount(ids, minlength=hi).astype(np.float64)
+        self._n_live_stat = int(lengths.shape[0])
+        self._len_sum_stat = float(np.asarray(lengths,
+                                              np.float64).sum())
+        self._df_journal = [(term_ids, 1.0)]
+
     def delete_document(self, name: str) -> bool:
         with self._write_lock:
             entry = self._pending.pop(name, None)

@@ -136,6 +136,34 @@ class MeshIndex:
             self._gen += 1
         global_metrics.inc("docs_indexed")
 
+    def bulk_load_packed(self, names, offsets, term_ids, tfs,
+                         lengths) -> None:
+        """Checkpoint-restore fast path: register the packed doc table
+        as pending upserts in one pass (per-doc numpy VIEWS, no
+        per-document ingest work); the next commit builds the sharded
+        arrays in ONE vectorized rebuild. Placement is re-derived
+        (round-robin) — scoring is placement-invariant because df/IDF
+        are globalized by psum; only parity mode's per-shard statistics
+        can differ from the pre-checkpoint placement."""
+        from tfidf_tpu.engine.index import entries_from_packed
+        entries, (offsets, term_ids, tfs, lengths) = \
+            entries_from_packed(names, offsets, term_ids, tfs, lengths)
+        with self._write_lock:
+            if self._pending or self._placed or any(self._shard_docs):
+                raise ValueError(
+                    "bulk_load_packed requires an empty index")
+            self._pending = {e.name: e for e in entries}
+            if len(self._pending) != len(entries):
+                self._pending = {}
+                raise ValueError("bulk_load_packed: duplicate names")
+            self._bulk_load_stats(term_ids, lengths)
+            self._gen += 1
+        global_metrics.inc("docs_indexed", len(entries))
+
+    def _bulk_load_stats(self, term_ids, lengths) -> None:
+        """Hook for subclasses with incremental stat accumulators
+        (caller holds the write lock)."""
+
     def delete_document(self, name: str) -> bool:
         with self._write_lock:
             if self._pending.pop(name, None) is not None:

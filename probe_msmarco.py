@@ -41,8 +41,11 @@ def main():
 
     rng = np.random.default_rng(0)
     # query_batch 16: at 8.8M docs the padded score space is ~11M
-    # columns; two pipelined [B, 11M] f32 buffers at B=64 tipped the
-    # 16GB HBM over by 240MB alongside the resident postings
+    # columns, and depth-2 pipelining keeps up to THREE chunks in
+    # flight (dispatch-then-drain = depth+1, see
+    # searcher._run_pipelined); three [B, 11M] f32 score buffers at
+    # B=64 overflow 16GB HBM alongside the resident postings (two
+    # already tipped it over by 240MB) — B=16 leaves ~2GB slack
     engine = Engine(Config(
         index_mode="segments", query_batch=16,
         merge_upload_pace=float(os.environ.get("PROBE_PACE", "1.0"))))

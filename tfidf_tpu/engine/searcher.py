@@ -94,24 +94,27 @@ class QueryVectorizerMixin:
         return qb, widest
 
     def _run_pipelined(self, chunks, dispatch, finish) -> list:
-        """Run ``dispatch(chunk) -> state`` over chunks keeping up to
-        ``pipeline_depth`` states in flight before ``finish(*state)``
-        collects each — later chunks' device programs launch before
-        earlier chunks' results are fetched, hiding the device->host
-        RTT under compute."""
+        """Run ``dispatch(chunk) -> state`` over chunks with up to
+        ``pipeline_depth`` OVERLAPPED fetches — later chunks' device
+        programs launch before earlier chunks' results are fetched,
+        hiding the device->host RTT under compute.
+
+        In-flight accounting (ADVICE r4, option B): dispatch-then-drain
+        keeps **depth+1 chunks in flight** (depth fetches overlapping
+        the newest chunk's compute). The r5 drain-before-dispatch
+        variant (depth chunks total, depth-1 overlapped) measured ~2x
+        slower on RTT-bound configs, so the extra in-flight buffer is
+        kept deliberately — HBM sizing must budget depth+1 packed
+        buffers (see probe_msmarco's B cap)."""
         from collections import deque
 
         depth = getattr(self, "pipeline_depth", 1)
         pending: deque = deque()
         out: list = []
         for chunk in chunks:
-            # drain BEFORE dispatching so at most ``depth`` chunks are
-            # in flight including the new one — dispatch-then-drain kept
-            # depth+1 buffers live, quietly shrinking the HBM headroom
-            # the probes derive from the documented depth (ADVICE r4)
-            while len(pending) >= depth:
-                out.extend(finish(*pending.popleft()))
             pending.append(dispatch(chunk))
+            if len(pending) > depth:
+                out.extend(finish(*pending.popleft()))
         while pending:
             out.extend(finish(*pending.popleft()))
         return out
@@ -137,10 +140,10 @@ class Searcher(QueryVectorizerMixin):
         self.use_pallas = use_pallas
         # in-flight chunks: on small corpora the device step is far
         # shorter than the device->host fetch RTT, so serial execution
-        # caps throughput at ~1 chunk per RTT; depth D keeps D chunks
-        # in flight INCLUDING the one just dispatched (so D-1 fetches
-        # overlap the newest chunk's compute; each pending chunk holds
-        # only a packed [B, 2k] top-k buffer)
+        # caps throughput at ~1 chunk per RTT; depth D keeps D fetches
+        # overlapped (D+1 chunks in flight including the one just
+        # dispatched — see _run_pipelined's in-flight accounting; each
+        # pending chunk holds only a packed [B, 2k] top-k buffer)
         self.pipeline_depth = max(1, pipeline_depth)
 
     def _batch_cap(self, n: int) -> int:

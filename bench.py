@@ -823,7 +823,7 @@ C2T_QUERY_BATCH = 512      # worker-side engine chunk == scatter batch:
                            # ONE device fetch per scatter RPC (the
                            # tunnel serializes d2h fetches; fewer+bigger
                            # fetches beat deeper pipelining)
-C2T_SCATTER_BATCH = 512    # leader-side coalesced scatter group
+C2T_SCATTER_BATCH = 1024   # leader-side group: 2 worker chunks, fetches overlap
 C2T_LINGER_MS = 5.0
 C2T_PARITY_QUERIES = 32
 

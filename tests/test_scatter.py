@@ -237,3 +237,49 @@ class TestNrtCommitBarrier:
             assert any(h.name == "n.txt" for h in hits)
         finally:
             node.stop()
+
+
+class TestCompileFlakeRetry:
+    def test_batch_search_retries_once_on_compile_error(self, core,
+                                                        tmp_path):
+        """A transient remote-compile failure (the tunnel's compile
+        helper returns HTTP 500) must not degrade a batch to empty
+        results: the pure search retries once."""
+        cfg = Config(
+            documents_path=str(tmp_path / "cf" / "documents"),
+            index_path=str(tmp_path / "cf" / "index"),
+            port=0, min_doc_capacity=64, min_nnz_capacity=1 << 12,
+            min_vocab_capacity=1 << 10, query_batch=4, max_query_terms=8)
+        node = SearchNode(cfg, coord=LocalCoordination(core, 0.1)).start()
+        try:
+            node.engine.ingest_text("a.txt", "needle body")
+            node.engine.commit()
+            orig = node.engine.search_batch
+            calls = {"n": 0}
+
+            def flaky(queries, k=None, unbounded=False):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError(
+                        "INTERNAL: remote_compile: HTTP 500: "
+                        "tpu_compile_helper subprocess exit code 1")
+                return orig(queries, k=k, unbounded=unbounded)
+
+            node.engine.search_batch = flaky
+            hits = node.worker_search_batch(["needle"])
+            assert calls["n"] == 2
+            assert [h.name for h in hits[0]] == ["a.txt"]
+
+            # non-compile errors propagate immediately (no blind retry)
+            calls["n"] = 0
+
+            def broken(queries, k=None, unbounded=False):
+                calls["n"] += 1
+                raise ValueError("scoring exploded")
+
+            node.engine.search_batch = broken
+            with pytest.raises(ValueError):
+                node.worker_search_batch(["needle"])
+            assert calls["n"] == 1
+        finally:
+            node.stop()

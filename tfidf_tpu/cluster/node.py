@@ -373,10 +373,22 @@ class SearchNode:
         scatter RPC). Bypasses the micro-batcher — the batch needs no
         linger for company — and runs the engine's batch path directly;
         searches are pure functions of the committed snapshot, so
-        concurrent batch RPCs are safe."""
+        concurrent batch RPCs are safe (and safe to retry once when the
+        remote compile service flakes — observed as transient HTTP 500s
+        from the tunnel's compile helper, which otherwise degrade every
+        batch of a new bucket size to empty results)."""
         self.commit_if_dirty()
         t0 = time.perf_counter()
-        out = self.engine.search_batch(queries, k=k)
+        try:
+            out = self.engine.search_batch(queries, k=k)
+        except Exception as e:
+            if "compile" not in repr(e).lower():
+                raise
+            global_metrics.inc("search_compile_retries")
+            log.warning("search failed in compilation; retrying once",
+                        err=repr(e)[:200])
+            time.sleep(0.5)
+            out = self.engine.search_batch(queries, k=k)
         global_metrics.observe("worker_batch_search",
                                time.perf_counter() - t0)
         return out

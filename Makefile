@@ -1,0 +1,25 @@
+# Test / chaos job targets.
+#
+#   make test    tier-1: fast deterministic suite (what the driver runs);
+#                includes tests/test_resilience.py's deterministic subset
+#   make chaos   slow probabilistic chaos job: fault injection armed on
+#                worker RPCs, heartbeats, and reconciles
+#                (tests/test_resilience.py -m slow)
+#   make faults  list every registered fault point (chaos configs should
+#                be validated against this — see utils/faults.py)
+
+PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
+
+.PHONY: test chaos faults bench
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
+
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py $(PYTEST_FLAGS) -m slow
+
+faults:
+	python -m tfidf_tpu faults list
+
+bench:
+	python bench.py

@@ -18,7 +18,13 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.4.34-ish) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS host-platform-device-count export above already covers it
+    # as long as no backend initialized yet — never fail collection here
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

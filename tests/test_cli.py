@@ -118,6 +118,10 @@ class TestClusterClientCommands:
             st = json.loads(out)
             assert st["status"] == "I am the leader"
             assert st["services"] == [nodes[1].url]
+            # failure-semantics summary: the healthy cluster reports a
+            # non-degraded last scatter and no open breakers
+            assert st["degraded"]["last_scatter_degraded"] is False
+            assert st["degraded"]["circuit_open_workers"] == []
 
             # bulk: a directory of text files in one batched request
             bdir = tmp_path / "bulk"

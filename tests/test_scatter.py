@@ -50,6 +50,18 @@ class TestWireFormat:
         with pytest.raises(ValueError):
             unpack_hit_lists(data[:-3])
 
+    def test_short_buffer_rejected_with_valueerror(self):
+        """Buffers shorter than the 8-byte header (or the counts region
+        the header promises) must raise ValueError per the wire
+        contract — not struct.error (ADVICE r5)."""
+        data = pack_hit_lists([[SearchHit("name.txt", 1.0)]])
+        for cut in (b"", b"\x31", data[:4], data[:7]):
+            with pytest.raises(ValueError):
+                unpack_hit_lists(cut)
+        # header intact but counts region missing
+        with pytest.raises(ValueError):
+            unpack_hit_lists(data[:8])
+
 
 @pytest.fixture
 def core():

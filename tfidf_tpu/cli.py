@@ -11,8 +11,9 @@ The reference ships one Spring Boot fat jar that every node runs
     search       query a local index
     upload       client: send a document to a running cluster's leader
     query        client: search a running cluster
-    status       client: node role + live membership
+    status       client: node role + live membership + degraded summary
     bench        run the TPU benchmark
+    faults       chaos tooling: list registered fault points
 
 Config resolution (lowest to highest): dataclass defaults, --config JSON
 file, TFIDF_* environment variables, explicit flags — mirroring the
@@ -280,11 +281,44 @@ def cmd_status(args) -> int:
     from tfidf_tpu.cluster.node import http_get
 
     url = _leader_url(args)
+    metrics = json.loads(http_get(url + "/api/metrics"))
     out = {"status": http_get(url + "/api/status").decode(),
            "services": json.loads(http_get(url + "/api/services")),
-           "metrics": json.loads(http_get(url + "/api/metrics"))}
+           "metrics": metrics}
+    # failure-semantics summary (README "Failure semantics"): was the
+    # last scatter-gather fan-out degraded, and which workers' circuit
+    # breakers are not closed right now
+    degraded = {
+        "last_scatter_degraded": bool(metrics.get("scatter_degraded", 0)),
+        "last_scatter_workers_attempted":
+            int(metrics.get("scatter_last_attempted", 0)),
+        "last_scatter_workers_responded":
+            int(metrics.get("scatter_last_responded", 0)),
+        "circuit_open_workers":
+            sorted(w for w, s in metrics.get("breaker_states", {}).items()
+                   if s != "closed"),
+    }
+    out["degraded"] = degraded
     print(json.dumps(out, indent=2))
     return 0
+
+
+def cmd_faults(args) -> int:
+    """``faults list``: print every fault point compiled into the tree
+    (name + firing site) so chaos configs can be checked against the
+    code instead of silently going stale."""
+    from tfidf_tpu.utils.faults import KNOWN_FAULT_POINTS
+
+    if args.action == "list":
+        try:
+            for name in sorted(KNOWN_FAULT_POINTS):
+                print(f"{name}\t{KNOWN_FAULT_POINTS[name]}")
+        except BrokenPipeError:   # e.g. `faults list | head` — not an error
+            import os
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    print(f"unknown faults action: {args.action}", file=sys.stderr)
+    return 2
 
 
 def cmd_bench(args) -> int:
@@ -367,6 +401,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("bench", help="run the TPU benchmark")
     s.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser("faults",
+                       help="chaos tooling: inspect fault points")
+    s.add_argument("action", choices=["list"],
+                   help="list: print all registered fault points")
+    s.set_defaults(fn=cmd_faults)
     return p
 
 

@@ -15,6 +15,9 @@ The TPU-native replacement for the reference's coordination stack
   worker data-plane API; the elected leader additionally serves the
   coordinator API (``leader/Leader.java``, ``worker/Worker.java``,
   ``controller/Controllers.java``).
+- :mod:`resilience` — the failure discipline shared by every
+  leader->worker RPC path: bounded retry with backoff + jitter, and
+  per-worker circuit breakers (closed/open/half-open).
 """
 
 from tfidf_tpu.cluster.coordination import (CoordinationCore,
@@ -23,10 +26,13 @@ from tfidf_tpu.cluster.coordination import (CoordinationCore,
                                             LocalCoordination, Event)
 from tfidf_tpu.cluster.election import LeaderElection, OnElectionCallback
 from tfidf_tpu.cluster.registry import ServiceRegistry
+from tfidf_tpu.cluster.resilience import (BreakerBoard, CircuitBreaker,
+                                          CircuitOpenError, RetryPolicy)
 from tfidf_tpu.cluster.node import SearchNode
 
 __all__ = [
     "CoordinationCore", "CoordinationServer", "CoordinationClient",
     "LocalCoordination", "Event", "LeaderElection", "OnElectionCallback",
-    "ServiceRegistry", "SearchNode",
+    "ServiceRegistry", "SearchNode", "RetryPolicy", "CircuitBreaker",
+    "CircuitOpenError", "BreakerBoard",
 ]

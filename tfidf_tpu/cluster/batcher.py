@@ -136,6 +136,10 @@ class Coalescer:
                 for w, r in zip(batch, results):
                     w.result = r
             except Exception as e:
+                # honest propagation: every coalesced caller sees the
+                # SAME failure (never a fabricated empty success), and
+                # the counter sizes the blast radius of one bad batch
+                global_metrics.inc(f"{self.name}_batch_failures")
                 for w in batch:
                     w.error = e
             for w in batch:

@@ -39,8 +39,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, NamedTuple
 from urllib.parse import parse_qs, urlparse
 
+from tfidf_tpu.cluster.resilience import RetryPolicy
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
+from tfidf_tpu.utils.metrics import global_metrics
 
 log = get_logger("cluster.coordination")
 
@@ -341,14 +343,25 @@ class _BaseCoordination:
         with self._wlock:
             self._session_watchers.append(watcher)
 
+    # long-poll failure backoff: exponential with jitter, reset by any
+    # successful poll — a down coordination server is retried at a
+    # decaying rate instead of a fixed 10 Hz hammer
+    _POLL_BACKOFF = RetryPolicy(base_delay_s=0.1, max_delay_s=2.0,
+                                name="coord_poll")
+
     def _dispatch_loop(self) -> None:
+        poll_failures = 0
         while not self._closed.is_set():
             try:
                 events = self._poll(timeout_s=1.0)
+                poll_failures = 0
             except Exception:
                 if self._closed.is_set():
                     return
-                time.sleep(0.1)
+                poll_failures += 1
+                global_metrics.inc("coord_poll_failures")
+                time.sleep(self._POLL_BACKOFF.backoff_delay(
+                    min(poll_failures, 5)))
                 continue
             for ev in events:
                 if ev.type == SESSION_EXPIRED:
@@ -414,15 +427,30 @@ class LocalCoordination(_BaseCoordination):
         self.start()
 
     def _hb_loop(self, interval: float) -> None:
+        # heartbeats ARE the liveness signal: a transiently failing send
+        # is retried quickly (bounded, well inside the session timeout)
+        # instead of waiting a whole interval and eating into the
+        # failure detector's budget
+        policy = RetryPolicy(max_attempts=3,
+                             base_delay_s=min(0.05, interval / 4),
+                             max_delay_s=interval / 2,
+                             classify=lambda e: True,
+                             name="coord_heartbeat")
         while not self._closed.is_set():
             time.sleep(interval)
+
+            def send() -> bool:
+                global_injector.check("coord.heartbeat_send")
+                return self.core.heartbeat(self.sid)
+
             try:
-                if not self.core.heartbeat(self.sid):
-                    return
+                if not policy.call(send):
+                    return   # session is gone; expiry event follows
             except Exception:
-                pass
+                pass   # retries exhausted: try again next interval
 
     def _poll(self, timeout_s: float) -> list[Event]:
+        global_injector.check("coord.long_poll")
         return self.core.poll_events(self.sid, timeout_s)
 
     def create(self, path, data=b"", mode=PERSISTENT):
@@ -589,15 +617,29 @@ class CoordinationClient(_BaseCoordination):
             raise
 
     def _hb_loop(self, interval: float) -> None:
+        # same discipline as LocalCoordination: retry a failed heartbeat
+        # send quickly (bounded backoff) rather than burning a full
+        # interval of the session-timeout budget per transient blip
+        policy = RetryPolicy(max_attempts=3,
+                             base_delay_s=min(0.05, interval / 4),
+                             max_delay_s=interval / 2,
+                             classify=lambda e: True,
+                             name="coord_heartbeat")
         while not self._closed.is_set():
             time.sleep(interval)
+
+            def send() -> bool:
+                global_injector.check("coord.heartbeat_send")
+                return bool(self._rpc({"op": "heartbeat"}).get("ok"))
+
             try:
-                if not self._rpc({"op": "heartbeat"}).get("ok"):
-                    return
+                if not policy.call(send):
+                    return   # session is gone; expiry event follows
             except Exception:
-                pass  # transient server unavailability: keep trying
+                pass  # retries exhausted: keep trying next interval
 
     def _poll(self, timeout_s: float) -> list[Event]:
+        global_injector.check("coord.long_poll")
         url = (f"{self.base}/events?session={self.sid}"
                f"&timeout={timeout_s}")
         with urllib.request.urlopen(url, timeout=timeout_s + 5) as resp:

@@ -48,6 +48,8 @@ from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
 from tfidf_tpu.cluster.wire import pack_hit_lists, unpack_hit_lists
 from tfidf_tpu.cluster.election import LeaderElection
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
+from tfidf_tpu.cluster.resilience import (CircuitOpenError,
+                                          ClusterResilience, RpcStatusError)
 from tfidf_tpu.engine.engine import Engine
 from tfidf_tpu.ops.analyzer import UnsupportedMediaType
 from tfidf_tpu.utils.config import Config
@@ -139,7 +141,10 @@ class _ScatterClient:
                 r = c.getresponse()
                 body = r.read()
                 if r.status >= 300:
-                    raise RuntimeError(f"{base}{path} -> {r.status}")
+                    # typed status error: the resilience layer retries
+                    # gateway-transient statuses (502/503/504), never
+                    # 4xx (application) or deterministic 500s
+                    raise RpcStatusError(f"{base}{path}", r.status)
                 return body
             except RuntimeError:
                 raise
@@ -230,6 +235,12 @@ class SearchNode:
         # per-document cost on bulk ingest
         self._dirty = False
         self._commit_lock = threading.Lock()
+        # transient-compile retry budget per query-batch bucket size: a
+        # successful search at a bucket refills it; a deterministic
+        # compile error (e.g. OOM at a new bucket) drains it and stops
+        # being retried, so it cannot double every batch's cost forever
+        self._compile_retry_lock = threading.Lock()
+        self._compile_retries_used: dict[int, int] = {}
         # leader-side upload placement: TTL cache over worker index
         # sizes + in-tenure name->worker map (re-uploads route to the
         # holder, keeping one copy per name; see leader_upload)
@@ -248,6 +259,24 @@ class SearchNode:
         # a rejoin cannot interleave with an in-flight recovery.
         self._moved: dict[str, set[str]] = {}
         self._reconcile_serial = threading.Lock()
+        # retry policy + per-worker circuit breakers shared by every
+        # leader->worker RPC path (cluster/resilience.py)
+        self.resilience = ClusterResilience(self.config)
+        # last-observed scatter health (attempted / responded /
+        # circuit-open) for the CLI summary; per-REQUEST markers are
+        # returned by leader_search_with_health — the degraded header is
+        # stamped from the returned value, never from this shared copy
+        self._scatter_health: dict[str, int] = {}
+        # periodic reconciliation sweep: retries failed /worker/delete
+        # reconciles (ADVICE r5 medium — without it a failed reconcile
+        # leaves moved docs double-indexed until the NEXT membership
+        # event) — started in start(), runs only while leader
+        self._sweep_thread = None
+        if (self.config.shard_recovery
+                and self.config.reconcile_sweep_interval_s > 0):
+            self._sweep_thread = threading.Thread(
+                target=self._reconcile_sweep_loop, daemon=True,
+                name=f"reconcile-sweep-{self.config.port}")
         # the durable store of placed documents lives BESIDE the served
         # documents dir, never inside it: the leader's own boot re-walk
         # must not index copies of documents that live on other workers
@@ -299,6 +328,8 @@ class SearchNode:
         self.election.reelect_leader()
         if self._ckpt_thread is not None:
             self._ckpt_thread.start()
+        if self._sweep_thread is not None:
+            self._sweep_thread.start()
         log.info("node started", url=self.url,
                  leader=self.election.is_leader())
         return self
@@ -367,28 +398,56 @@ class SearchNode:
             return self.batcher.search(query, unbounded=unbounded)
         return self.engine.search(query, unbounded=unbounded)
 
+    # the tunnel's remote-compile service flakes as transient HTTP 500s
+    # with these markers in the error; only THIS signature is worth a
+    # blind retry (the old gate matched the substring "compile" anywhere
+    # in repr(e), retrying arbitrary unrelated errors — ADVICE r5)
+    @staticmethod
+    def _is_transient_compile_error(e: BaseException) -> bool:
+        r = repr(e).lower()
+        if "remote_compile" in r or "tpu_compile_helper" in r:
+            return True
+        return "http 500" in r and "compile" in r
+
+    def _compile_bucket(self, n_queries: int) -> int:
+        """Query batches pad to power-of-two buckets; the retry budget is
+        tracked per bucket because a deterministic compile failure is a
+        property of the compiled shape, not of one request."""
+        return 1 << max(0, n_queries - 1).bit_length() if n_queries else 0
+
     def worker_search_batch(self, queries: list[str],
                             k: int | None = None) -> list[list]:
         """Score an already-formed query batch (the leader's batched
         scatter RPC). Bypasses the micro-batcher — the batch needs no
         linger for company — and runs the engine's batch path directly;
         searches are pure functions of the committed snapshot, so
-        concurrent batch RPCs are safe (and safe to retry once when the
-        remote compile service flakes — observed as transient HTTP 500s
-        from the tunnel's compile helper, which otherwise degrade every
-        batch of a new bucket size to empty results)."""
+        concurrent batch RPCs are safe. A failure matching the known
+        transient remote-compile signature is retried once, with a
+        per-bucket-size budget: a deterministic compile error (e.g. OOM
+        at a new bucket) drains the budget and then propagates
+        immediately instead of doubling every batch's cost forever."""
         self.commit_if_dirty()
+        bucket = self._compile_bucket(len(queries))
         t0 = time.perf_counter()
         try:
             out = self.engine.search_batch(queries, k=k)
         except Exception as e:
-            if "compile" not in repr(e).lower():
+            if not self._is_transient_compile_error(e):
                 raise
+            with self._compile_retry_lock:
+                used = self._compile_retries_used.get(bucket, 0)
+                if used >= self.config.compile_retry_per_bucket:
+                    raise   # budget spent: treat as deterministic
+                self._compile_retries_used[bucket] = used + 1
             global_metrics.inc("search_compile_retries")
             log.warning("search failed in compilation; retrying once",
-                        err=repr(e)[:200])
+                        err=repr(e)[:200], bucket=bucket)
             time.sleep(0.5)
             out = self.engine.search_batch(queries, k=k)
+        with self._compile_retry_lock:
+            # success refills the bucket's budget: only CONSECUTIVE
+            # failures at a bucket look deterministic
+            self._compile_retries_used.pop(bucket, None)
         global_metrics.observe("worker_batch_search",
                                time.perf_counter() - t0)
         return out
@@ -508,34 +567,97 @@ class SearchNode:
         per worker (:meth:`_scatter_search_batch`). The per-query JSON
         fan-out below remains for unbounded-results (parity) configs and
         ``scatter_micro_batch=False``."""
+        return self.leader_search_with_health(query)[0]
+
+    def leader_search_with_health(self, query: str
+                                  ) -> tuple[dict[str, float], dict]:
+        """``leader_search`` plus this request's OWN health marker —
+        ``(merged, {attempted, responded, circuit_open, degraded})``.
+        The handler stamps the degraded header from the returned value:
+        reading it back off shared node state would let two concurrent
+        scatters mislabel each other's replies."""
         if self.scatter_batcher is not None:
             return self.scatter_batcher.submit(query)
         workers = self.registry.get_all_service_addresses()
         log.info("scatter search", query=query, workers=len(workers))
 
         live = set(workers)
+        self.resilience.board.prune(live)
+        excluded = self._pending_reconcile()
 
         def one(addr: str) -> list:
-            global_injector.check("leader.worker_rpc")
-            body = json.dumps({"query": query}).encode()
-            return json.loads(self._scatter.post(
-                addr, "/worker/process", body, timeout=10.0, live=live))
+            def rpc() -> list:
+                global_injector.check("leader.worker_rpc")
+                body = json.dumps({"query": query}).encode()
+                return json.loads(self._scatter.post(
+                    addr, "/worker/process", body, timeout=10.0,
+                    live=live))
+            # breaker + bounded retry around the whole logical RPC
+            return self.resilience.worker_call(addr, rpc)
 
         merged: dict[str, float] = {}
+        responded = circuit_open = 0
         futures = {self._pool.submit(one, w): w for w in workers}
         for fut, addr in futures.items():
             try:
                 hits = fut.result()
+            except CircuitOpenError:
+                # fast-failed without an RPC: the worker's breaker is
+                # open — counted separately so the degraded marker can
+                # distinguish "skipped sick worker" from "RPC failed"
+                circuit_open += 1
+                global_metrics.inc("scatter_circuit_open")
+                continue
             except Exception as e:
                 # per-worker tolerance (Leader.java:67-69)
                 global_metrics.inc("scatter_failures")
                 log.warning("worker failed during search", worker=addr,
                             err=repr(e))
                 continue
+            responded += 1
+            skip = excluded.get(addr)
             for hit in hits:
                 name = hit["document"]["name"]
+                if skip is not None and name in skip:
+                    # moved away but not yet reconciled off this
+                    # rejoiner: the survivor's copy already counts it —
+                    # merging both would double-count (ADVICE r5)
+                    global_metrics.inc("scatter_hits_excluded")
+                    continue
                 merged[name] = merged.get(name, 0.0) + float(hit["score"])
-        return self._order_merged(merged)
+        health = self._record_scatter_health(len(workers), responded,
+                                             circuit_open)
+        return self._order_merged(merged), health
+
+    def _pending_reconcile(self) -> dict[str, frozenset]:
+        """Names moved AWAY from each worker whose rejoin reconcile has
+        not yet succeeded — excluded from that worker's merged hits so
+        the double-count window closes at merge time, not only when the
+        sweep finally lands."""
+        with self._placement_lock:
+            return {w: frozenset(ns) for w, ns in self._moved.items()
+                    if ns}
+
+    def _record_scatter_health(self, attempted: int, responded: int,
+                               circuit_open: int) -> dict:
+        """Publish one fan-out's health: gauges in /api/metrics plus a
+        last-observed copy on the node (for the CLI summary). Returns
+        the marker dict — the handler stamps the degraded header from
+        the RETURNED value, which belongs to this request alone."""
+        degraded = 1 if responded < attempted else 0
+        health = {
+            "attempted": attempted, "responded": responded,
+            "circuit_open": circuit_open, "degraded": degraded}
+        self._scatter_health = health
+        global_metrics.set_gauge("scatter_last_attempted", attempted)
+        global_metrics.set_gauge("scatter_last_responded", responded)
+        global_metrics.set_gauge("scatter_last_circuit_open", circuit_open)
+        global_metrics.set_gauge("scatter_degraded", degraded)
+        global_metrics.set_gauge("breaker_open_workers",
+                                 self.resilience.board.open_count())
+        if degraded:
+            global_metrics.inc("degraded_responses")
+        return health
 
     def _order_merged(self, merged: dict[str, float]) -> dict[str, float]:
         """Truncate + order one query's sum-merged scores."""
@@ -563,20 +685,28 @@ class SearchNode:
         results exactly like the per-query path."""
         workers = self.registry.get_all_service_addresses()
         live = set(workers)
+        self.resilience.board.prune(live)
+        excluded = self._pending_reconcile()
         body = json.dumps({"queries": queries,
                            "k": self.config.top_k}).encode()
 
         def one(addr: str) -> bytes:
-            global_injector.check("leader.worker_rpc")
-            t0 = time.perf_counter()
-            raw = self._scatter.post(
-                addr, "/worker/process-batch", body,
-                timeout=self.config.scatter_timeout_s, live=live)
-            global_metrics.observe("scatter_rpc",
-                                   time.perf_counter() - t0)
-            return raw
+            def rpc() -> bytes:
+                global_injector.check("leader.worker_rpc")
+                t0 = time.perf_counter()
+                raw = self._scatter.post(
+                    addr, "/worker/process-batch", body,
+                    timeout=self.config.scatter_timeout_s, live=live)
+                global_metrics.observe("scatter_rpc",
+                                       time.perf_counter() - t0)
+                return raw
+            # breaker + bounded retry around the whole logical RPC; an
+            # engine failure now arrives as a 500 (honest propagation)
+            # and fails fast — only gateway-transient statuses retry
+            return self.resilience.worker_call(addr, rpc)
 
         merged: list[dict[str, float]] = [{} for _ in queries]
+        responded = circuit_open = 0
         futures = {self._pool.submit(one, w): w for w in workers}
         for fut, addr in futures.items():
             try:
@@ -585,6 +715,10 @@ class SearchNode:
                 hit_lists = unpack_hit_lists(raw)
                 global_metrics.observe("scatter_decode",
                                        time.perf_counter() - t0)
+            except CircuitOpenError:
+                circuit_open += 1
+                global_metrics.inc("scatter_circuit_open")
+                continue
             except Exception as e:
                 # per-worker tolerance (Leader.java:67-69) — a reply
                 # that fails wire validation degrades to partial
@@ -597,11 +731,23 @@ class SearchNode:
                 global_metrics.inc("scatter_failures")
                 log.warning("batch reply length mismatch", worker=addr)
                 continue
+            responded += 1
+            skip = excluded.get(addr)
             for m, hits in zip(merged, hit_lists):
                 for name, score in hits:
+                    if skip is not None and name in skip:
+                        # pending-reconcile copy on a rejoiner: the
+                        # survivor's copy already counts (ADVICE r5)
+                        global_metrics.inc("scatter_hits_excluded")
+                        continue
                     m[name] = m.get(name, 0.0) + score
+        health = self._record_scatter_health(len(workers), responded,
+                                             circuit_open)
         t0 = time.perf_counter()
-        out = [self._order_merged(m) for m in merged]
+        # one (result, health) pair per coalesced query: every caller in
+        # the group shares this batch's fan-out, so each reply carries
+        # this batch's marker
+        out = [(self._order_merged(m), health) for m in merged]
         global_metrics.observe("scatter_merge", time.perf_counter() - t0)
         return out
 
@@ -674,25 +820,92 @@ class SearchNode:
         deleting the sole copy is impossible by construction."""
         with self._reconcile_serial:
             for w in joined:
-                with self._placement_lock:
-                    moved = self._moved.pop(w, None)
-                if not moved:
-                    continue
-                try:
-                    resp = json.loads(http_post(
-                        w + "/worker/delete",
-                        json.dumps({"names": sorted(moved)}).encode(),
-                        timeout=120.0))
-                    log.info("reconciled rejoined worker", worker=w,
-                             deleted=resp.get("deleted", 0))
-                except Exception as e:
-                    # failed reconcile: remember for the next join
-                    with self._placement_lock:
-                        self._moved.setdefault(w, set()).update(moved)
-                    log.warning("rejoin reconciliation failed", worker=w,
-                                err=repr(e))
+                self._reconcile_rejoined(w)
             for w in lost:
                 self._recover_lost_worker(w)
+
+    def _reconcile_rejoined(self, w: str) -> bool:
+        """Delete this rejoiner's moved documents from it (one retried,
+        breaker-gated RPC). The names stay in ``_moved`` — and therefore
+        excluded from ``w``'s merged results — until the worker CONFIRMS
+        the deletes; popping them up front would open a double-count
+        window for every search that races the RPC (the transient
+        variant of the ADVICE r5 finding). On failure the sweep (and any
+        next join event) retries. Caller holds ``_reconcile_serial``."""
+        with self._placement_lock:
+            moved = set(self._moved.get(w, ()))
+        if not moved:
+            return True
+
+        def rpc() -> dict:
+            global_injector.check("leader.reconcile_rpc")
+            return json.loads(http_post(
+                w + "/worker/delete",
+                json.dumps({"names": sorted(moved)}).encode(),
+                timeout=120.0))
+
+        try:
+            resp = self.resilience.worker_call(w, rpc)
+        except Exception as e:
+            global_metrics.inc("reconcile_failures")
+            log.warning("rejoin reconciliation failed", worker=w,
+                        err=repr(e))
+            return False
+        with self._placement_lock:
+            cur = self._moved.get(w)
+            if cur is not None:
+                cur -= moved   # names moved DURING the RPC stay pending
+                if not cur:
+                    del self._moved[w]
+        global_metrics.inc("reconciles_completed")
+        log.info("reconciled rejoined worker", worker=w,
+                 deleted=resp.get("deleted", 0))
+        return True
+
+    def _reconcile_sweep_loop(self) -> None:
+        """Leader-side periodic retry of failed rejoin reconciles
+        (ADVICE r5 medium: without it a failed /worker/delete leaves
+        moved documents double-indexed until the NEXT membership
+        change). Runs on every node; does work only while leader with
+        pending ``_moved`` entries."""
+        interval = self.config.reconcile_sweep_interval_s
+        while not self._stopping:
+            time.sleep(interval)
+            if self._stopping:
+                return
+            try:
+                # is_leader() can itself raise in the window where a
+                # session-expiry rejoin has rebuilt the election but not
+                # yet re-volunteered — a sweep thread must survive every
+                # transient, or reconciles stop retrying forever
+                if not self.is_leader():
+                    continue
+                self.run_reconcile_sweep()
+            except Exception as e:
+                log.warning("reconcile sweep pass failed", err=repr(e))
+
+    def run_reconcile_sweep(self) -> int:
+        """One sweep pass: retry the pending reconcile of every worker
+        that is currently live (a still-dead worker has nothing indexed
+        to delete; its join event or a later pass will catch it).
+        Returns the number of workers converged. Public so tests and
+        operators can force a pass without waiting for the timer."""
+        with self._placement_lock:
+            pending = [w for w, ns in self._moved.items() if ns]
+        if not pending:
+            return 0
+        global_injector.check("leader.sweep")
+        global_metrics.inc("reconcile_sweeps")
+        live = set(self.registry.get_all_service_addresses())
+        done = 0
+        for w in pending:
+            if w not in live or self._stopping:
+                continue
+            global_metrics.inc("reconcile_sweep_retries")
+            with self._reconcile_serial:
+                if self._reconcile_rejoined(w):
+                    done += 1
+        return done
 
     def _recover_lost_worker(self, w: str) -> None:
         with self._placement_lock:
@@ -803,9 +1016,17 @@ class SearchNode:
                 return
         polled = {}
         for w in workers:   # serial polling, like Leader.java:170-179
+            if self.resilience.board.is_open(w):
+                continue   # don't pay the poll timeout for a sick worker
             try:
-                global_injector.check("leader.size_poll")
-                polled[w] = int(http_get(w + "/worker/index-size"))
+                def poll(w=w) -> int:
+                    global_injector.check("leader.size_poll")
+                    return int(http_get(w + "/worker/index-size"))
+                # breaker-tracked, no retry: the TTL cache re-polls soon
+                # anyway, and failed polls feed the breaker so repeat
+                # offenders drop out of the serial loop above
+                polled[w] = self.resilience.worker_call(w, poll,
+                                                        retry=False)
             except Exception as e:
                 log.warning("index-size poll failed", worker=w,
                             err=repr(e))
@@ -831,18 +1052,21 @@ class SearchNode:
                 self._size_cache = (ts2, {**polled, **cur})
 
     def _route_name(self, name: str, workers: list[str],
-                    sizes: dict[str, int]):
+                    sizes: dict[str, int],
+                    candidates: list[str] | None = None):
         """Route one document name to a worker. Caller holds
         ``_placement_lock``. A held name goes to its holder — membership
-        is judged against the REGISTRY list, not poll success, so one
-        transient size-poll failure cannot re-place an already-placed
-        name on a second worker. New names go least-loaded among workers
-        present in ``sizes`` and are tentatively claimed; returns
-        ``(worker, claim_token | None)``."""
+        is judged against the REGISTRY list (``workers``), not poll
+        success or breaker state, so a transient size-poll failure or an
+        open breaker cannot re-place an already-placed name on a second
+        worker (duplicate copies double-count in the sum-merge). New
+        names go least-loaded among ``candidates`` (the breaker-filtered
+        subset; defaults to ``workers``) present in ``sizes`` and are
+        tentatively claimed; returns ``(worker, claim_token | None)``."""
         held = self._placement.get(name)
         if held in workers:
             return held, None
-        live = {w: sizes[w] for w in workers if w in sizes}
+        live = {w: sizes[w] for w in (candidates or workers) if w in sizes}
         if not live:
             raise RuntimeError("no reachable workers")
         chosen = min(live, key=lambda w: (live[w], w))
@@ -931,6 +1155,12 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
+        # route NEW names away from workers with open breakers (held
+        # names still go to their holder — single-copy beats liveness);
+        # if every breaker is open, fall through and let the call fail
+        # honestly rather than refuse on possibly-stale breaker state
+        route_workers = [w for w in workers
+                         if not self.resilience.board.is_open(w)] or workers
         with self._placement_lock:
             held = self._placement.get(filename)
             if held in workers:
@@ -940,15 +1170,20 @@ class SearchNode:
                 chosen = None
         token = None
         if chosen is None:
-            self._ensure_sizes_fresh(workers)   # polls outside the lock
+            self._ensure_sizes_fresh(route_workers)  # polls off the lock
             with self._placement_lock:
                 chosen, token = self._route_name(
-                    filename, workers, self._size_cache[1])
+                    filename, workers, self._size_cache[1], route_workers)
                 self._track_inflight(filename)
         q = urllib.parse.quote(filename)
         try:
-            http_post(chosen + f"/worker/upload?name={q}", data,
-                      content_type="application/octet-stream")
+            # retried (bounded) on transient transport failures: the
+            # worker-side ingest is an idempotent upsert by name, so a
+            # double-applied attempt converges to the same index state
+            self.resilience.worker_call(
+                chosen, lambda: http_post(
+                    chosen + f"/worker/upload?name={q}", data,
+                    content_type="application/octet-stream"))
         except BaseException as e:
             # a 4xx is an APPLICATION rejection (e.g. 415 on binary
             # formats) from a healthy worker — don't evict it from the
@@ -988,6 +1223,9 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
+        # same open-breaker routing rule as the per-file path
+        route_workers = [w for w in workers
+                         if not self.resilience.board.is_open(w)] or workers
         # validate BEFORE any tracking: a KeyError mid-planning-loop
         # would leak inflight counts + claims for docs already routed,
         # pinning those names to never-confirmed placements forever
@@ -1003,18 +1241,19 @@ class SearchNode:
         # holds documents it never received. New names are tentatively
         # claimed (token-identified) under the lock so a concurrent
         # upload of the same name routes to the same worker.
-        self._ensure_sizes_fresh(workers)   # polls outside the lock
+        self._ensure_sizes_fresh(route_workers)   # polls outside the lock
         per_worker: dict[str, list[dict]] = {}
         claimed: dict[str, dict[str, object]] = {}   # w -> {name: token}
         with self._placement_lock:
             # plan against a local estimate so the batch itself spreads
             # by projected size; claims/placements go through the same
             # routing rule as the per-file path
-            est = {w: self._size_cache[1][w] for w in workers
+            est = {w: self._size_cache[1][w] for w in route_workers
                    if w in self._size_cache[1]}
             for d in docs:
                 name = d["name"]
-                w, token = self._route_name(name, workers, est)
+                w, token = self._route_name(name, workers, est,
+                                            route_workers)
                 if token is not None:
                     claimed.setdefault(w, {})[name] = token
                 self._track_inflight(name)
@@ -1031,9 +1270,12 @@ class SearchNode:
         failed: list[str] = []   # names in transport-errored groups
         for w, group in per_worker.items():
             try:
-                resp = json.loads(http_post(
-                    w + "/worker/upload-batch",
-                    json.dumps(group).encode(), timeout=300.0))
+                # bounded transient retry; worker-side ingest is an
+                # idempotent upsert by name (see leader_upload)
+                resp = json.loads(self.resilience.worker_call(
+                    w, lambda w=w, group=group: http_post(
+                        w + "/worker/upload-batch",
+                        json.dumps(group).encode(), timeout=300.0)))
             except Exception as e:
                 errors[w] = repr(e)
                 failed.extend(d["name"] for d in group)
@@ -1101,9 +1343,17 @@ class SearchNode:
             pass
         q = urllib.parse.quote(rel)
         for w in self.registry.get_all_service_addresses():
+            if self.resilience.board.is_open(w):
+                continue   # skip sick workers; another may hold the doc
             try:
-                resp = urllib.request.urlopen(
-                    w + f"/worker/download?path={q}", timeout=30.0)
+                # breaker-tracked, no retry: probing the NEXT worker is
+                # this loop's retry. A 404 (doc lives elsewhere) is an
+                # app-level answer from a healthy worker — it does not
+                # count against the breaker.
+                resp = self.resilience.worker_call(
+                    w, lambda w=w: urllib.request.urlopen(
+                        w + f"/worker/download?path={q}", timeout=30.0),
+                    retry=False)
                 size = resp.headers.get("Content-Length")
                 return resp, (int(size) if size is not None else None)
             except Exception:
@@ -1143,15 +1393,19 @@ class _NodeHandler(BaseHTTPRequestHandler):
     # ---- plumbing ----
 
     def _send(self, code: int, body: bytes,
-              ctype: str = "application/json") -> None:
+              ctype: str = "application/json",
+              headers: dict[str, str] | None = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _json(self, obj, code: int = 200) -> None:
-        self._send(code, json.dumps(obj).encode())
+    def _json(self, obj, code: int = 200,
+              headers: dict[str, str] | None = None) -> None:
+        self._send(code, json.dumps(obj).encode(), headers=headers)
 
     def _text(self, s: str, code: int = 200) -> None:
         self._send(code, s.encode(), "text/plain; charset=utf-8")
@@ -1220,7 +1474,13 @@ class _NodeHandler(BaseHTTPRequestHandler):
             elif u.path == "/api/services":
                 self._json(node.registry.get_all_service_addresses())
             elif u.path == "/api/metrics":
-                self._json(global_metrics.snapshot())
+                snap = global_metrics.snapshot()
+                # live per-worker breaker states beside the counters —
+                # the CLI's degraded summary reads these
+                states = node.resilience.board.snapshot()
+                if states:
+                    snap["breaker_states"] = states
+                self._json(snap)
             else:
                 self._text("not found", 404)
         except Exception as e:
@@ -1255,9 +1515,17 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     results = node.worker_search_batch(
                         queries, k=int(k) if k is not None else None)
                 except Exception as e:
-                    # reference returns [] on any failure (Worker.java:183)
+                    # honest failure propagation (ADVICE r5): an engine
+                    # failure must surface as a 5xx the leader counts in
+                    # scatter_failures — NOT as an HTTP 200 all-empty
+                    # reply it would merge as a valid zero-hit result.
+                    # (The per-query /worker/process endpoint above keeps
+                    # the reference's []-on-failure parity shape,
+                    # Worker.java:183; this endpoint is leader-internal.)
+                    global_metrics.inc("worker_batch_failures")
                     log.warning("batch search failed", err=repr(e))
-                    results = [[] for _ in queries]
+                    self._text(f"batch search failed: {e!r}", 500)
+                    return
                 t0 = time.perf_counter()
                 body = pack_hit_lists(results)
                 global_metrics.observe("worker_batch_pack",
@@ -1331,7 +1599,16 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._text(str(e), 400)
             elif u.path == "/leader/start":
                 query = self._read_query()
-                self._json(node.leader_search(query))
+                result, health = node.leader_search_with_health(query)
+                # degraded marker: the body stays reference-compatible
+                # (name -> score), the header says whether every live
+                # worker's shard is represented in it
+                hdrs = None
+                if health.get("degraded"):
+                    hdrs = {"X-Scatter-Degraded":
+                            "attempted={attempted} responded={responded} "
+                            "circuit_open={circuit_open}".format(**health)}
+                self._json(result, headers=hdrs)
             elif u.path == "/leader/upload":
                 name, data = self._read_upload(u)
                 if not name:

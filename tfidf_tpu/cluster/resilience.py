@@ -1,0 +1,365 @@
+"""Cluster resilience primitives: retry policy + per-worker circuit breakers.
+
+The reference's only failure machinery is the ZooKeeper session timeout
+(the failure detector) plus swallow-and-continue scatter tolerance
+(``Leader.java:67-69``). That detects *death* but not *degradation*: a
+slow or flapping worker is retried at full cost on every RPC forever, and
+a transient blip fails a request that one cheap retry would have saved.
+This module adds the two missing disciplines, used by every leader→worker
+RPC path in :mod:`tfidf_tpu.cluster.node` and the coordination client's
+heartbeat/long-poll loops in :mod:`tfidf_tpu.cluster.coordination`:
+
+- :class:`RetryPolicy` — bounded attempts, exponential backoff with
+  jitter, an overall deadline, and a retryable-error classifier so only
+  *transient* failures are retried (connection resets, 5xx) while
+  application rejections (4xx) and timeouts propagate immediately.
+- :class:`CircuitBreaker` / :class:`BreakerBoard` — per-worker
+  closed → open → half-open breakers: after N consecutive failures the
+  leader stops paying the connect/timeout cost for a sick worker and
+  fast-fails (degraded, counted honestly) until a half-open probe
+  succeeds.
+
+Fault points (``tfidf_tpu.utils.faults``) cover every decision site —
+``resilience.backoff`` before each retry sleep, ``resilience.breaker_trip``
+when a breaker opens, ``resilience.breaker_probe`` when a half-open probe
+is admitted — so the chaos suite can count and bound them.
+"""
+
+from __future__ import annotations
+
+import http.client
+import random
+import socket
+import threading
+import time
+import urllib.error
+from typing import Callable
+
+from tfidf_tpu.utils.faults import FaultInjected, global_injector
+from tfidf_tpu.utils.logging import get_logger
+from tfidf_tpu.utils.metrics import global_metrics
+
+log = get_logger("cluster.resilience")
+
+
+class RpcStatusError(RuntimeError):
+    """A worker answered with a non-2xx status. Carrying the status as
+    data (instead of string-matching ``repr``) lets the retry classifier
+    distinguish gateway-transient statuses (retryable) from application
+    rejections and deterministic server failures (not)."""
+
+    def __init__(self, url: str, status: int) -> None:
+        super().__init__(f"{url} -> {status}")
+        self.url = url
+        self.status = status
+
+
+class CircuitOpenError(RuntimeError):
+    """Fast-fail: the target worker's breaker is open (or its single
+    half-open probe slot is taken). No RPC was attempted."""
+
+
+# connection-level failures: the peer is unreachable or the socket died.
+_CONNECTION_ERRORS = (
+    ConnectionError,            # covers reset/refused/aborted/broken pipe
+    http.client.BadStatusLine,
+    http.client.CannotSendRequest,
+    http.client.NotConnected,
+    http.client.RemoteDisconnected,
+)
+
+
+# statuses that signal TRANSIENT unavailability (gateway/overload) worth
+# a retry. A plain 500 is a deterministic server-side failure — e.g. a
+# worker engine crash on this very batch (/worker/process-batch's honest
+# failure reply) — and re-running it would multiply the sick worker's
+# engine load rpc_max_attempts-fold per scatter; fail fast and count it.
+_TRANSIENT_STATUSES = frozenset({502, 503, 504})
+
+
+def is_retryable(e: BaseException) -> bool:
+    """Default retry classifier: transient transport failures and
+    gateway-transient statuses (502/503/504). NOT retryable:
+    application-level 4xx (the request itself is wrong — retrying cannot
+    fix it), deterministic 500s (see ``_TRANSIENT_STATUSES``), and
+    timeouts (the worker may still be processing; a retry would double
+    the caller's latency budget, the same reasoning as
+    ``_ScatterClient``'s single stale-connection retry).
+    ``FaultInjected`` counts as transient so armed chaos faults exercise
+    the retry path."""
+    if isinstance(e, socket.timeout):   # subclass of OSError — check first
+        return False
+    if isinstance(e, FaultInjected):
+        return True
+    if isinstance(e, RpcStatusError):
+        return e.status in _TRANSIENT_STATUSES
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code in _TRANSIENT_STATUSES
+    if isinstance(e, urllib.error.URLError):
+        return isinstance(e.reason, _CONNECTION_ERRORS + (OSError,)) \
+            and not isinstance(e.reason, socket.timeout)
+    return isinstance(e, _CONNECTION_ERRORS)
+
+
+def is_worker_fault(e: BaseException) -> bool:
+    """Breaker accounting classifier: does this failure indict the WORKER
+    (count toward opening its breaker)? An application rejection (4xx,
+    e.g. 415 on a binary upload) comes from a healthy worker and must not
+    trip its breaker; everything else — connection failures, timeouts,
+    5xx — does."""
+    if isinstance(e, RpcStatusError):
+        return e.status >= 500
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code >= 500
+    return True
+
+
+class RetryPolicy:
+    """Bounded retry with exponential backoff + jitter and a deadline.
+
+    ``call(fn)`` runs ``fn`` up to ``max_attempts`` times. An exception
+    the classifier rejects propagates immediately; a retryable one sleeps
+    ``base * 2**attempt`` (capped at ``max_delay_s``, ±``jitter``
+    fraction) and tries again, unless attempts or the overall deadline
+    (``deadline_s``; 0 disables) would be exceeded. ``sleep``/``clock``/
+    ``rng`` are injectable for deterministic tests."""
+
+    def __init__(self, max_attempts: int = 3, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0, jitter: float = 0.25,
+                 deadline_s: float = 0.0,
+                 classify: Callable[[BaseException], bool] = is_retryable,
+                 name: str = "rpc", sleep=time.sleep,
+                 clock=time.monotonic, rng: random.Random | None = None
+                 ) -> None:
+        self.max_attempts = max(1, max_attempts)
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.jitter = jitter
+        self.deadline_s = deadline_s
+        self.classify = classify
+        self.name = name
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = rng or random.Random()
+
+    def backoff_delay(self, attempt: int) -> float:
+        """Delay before retry number ``attempt`` (1-based)."""
+        d = min(self.max_delay_s, self.base_delay_s * (2 ** (attempt - 1)))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, d)
+
+    def call(self, fn, classify=None):
+        classify = classify or self.classify
+        t0 = self._clock()
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn()
+            except Exception as e:
+                if attempt >= self.max_attempts or not classify(e):
+                    raise
+                delay = self.backoff_delay(attempt)
+                if (self.deadline_s > 0
+                        and self._clock() - t0 + delay > self.deadline_s):
+                    raise   # the budget is spent; honest failure now
+                global_metrics.inc(f"{self.name}_retries")
+                global_injector.check("resilience.backoff")
+                self._sleep(delay)
+        raise AssertionError("unreachable")   # loop always returns/raises
+
+
+# breaker states
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Per-target circuit breaker: closed → open after
+    ``failure_threshold`` CONSECUTIVE failures → half-open probe after
+    ``reset_s`` → closed on probe success, re-open on probe failure.
+
+    ``acquire()`` admits or rejects a call (one probe at a time while
+    half-open); the caller reports the outcome via ``record_success`` /
+    ``record_failure``. The fault points at the trip and probe sites are
+    observe-only: an armed ``raise`` there is swallowed (the fire counter
+    still increments) because both run inside callers' error paths."""
+
+    def __init__(self, failure_threshold: int = 5, reset_s: float = 5.0,
+                 clock=time.monotonic, name: str = "") -> None:
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_s = reset_s
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._open_until = 0.0
+        self._probe_inflight = False
+        self.transitions: list[str] = [CLOSED]   # audit trail for tests
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._state == OPEN and self._clock() >= self._open_until:
+                return HALF_OPEN   # would admit a probe
+            return self._state
+
+    def is_open(self) -> bool:
+        """Non-consuming check: True while calls would be rejected
+        outright (does NOT claim the half-open probe slot — use it for
+        routing decisions, ``acquire`` for actual calls)."""
+        with self._lock:
+            if self._state == OPEN:
+                return self._clock() < self._open_until
+            if self._state == HALF_OPEN:
+                return self._probe_inflight
+            return False
+
+    def acquire(self) -> None:
+        """Admit a call or raise :class:`CircuitOpenError`."""
+        with self._lock:
+            if self._state == CLOSED:
+                return
+            if self._state == OPEN:
+                if self._clock() < self._open_until:
+                    raise CircuitOpenError(
+                        f"breaker open for {self.name or 'target'}")
+                self._transition(HALF_OPEN)
+            # half-open: exactly one probe in flight
+            if self._probe_inflight:
+                raise CircuitOpenError(
+                    f"breaker half-open probe in flight for "
+                    f"{self.name or 'target'}")
+            self._probe_inflight = True
+        self._observe("resilience.breaker_probe")
+        global_metrics.inc("breaker_probes")
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+                closed = True
+            else:
+                closed = False
+        if closed:
+            global_metrics.inc("breaker_closed")
+            log.info("circuit breaker closed", target=self.name)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probe_inflight = False
+            tripped = False
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED
+                    and self._failures >= self.failure_threshold):
+                self._transition(OPEN)
+                self._open_until = self._clock() + self.reset_s
+                tripped = True
+            elif self._state == OPEN:
+                # failure observed while open (e.g. a call admitted just
+                # before the trip): push the reset window out
+                self._open_until = self._clock() + self.reset_s
+        if tripped:
+            self._observe("resilience.breaker_trip")
+            global_metrics.inc("breaker_opened")
+            log.warning("circuit breaker opened", target=self.name,
+                        failures=self._failures)
+
+    def _transition(self, state: str) -> None:
+        self._state = state
+        self.transitions.append(state)
+        if len(self.transitions) > 64:   # bounded audit trail: a
+            del self.transitions[:-64]   # flapping worker must not leak
+
+    @staticmethod
+    def _observe(point: str) -> None:
+        try:
+            global_injector.check(point)
+        except FaultInjected:
+            pass   # observe-only site; the fire counter already ticked
+
+
+class BreakerBoard:
+    """One :class:`CircuitBreaker` per worker URL, created on demand and
+    pruned when workers leave the registry."""
+
+    def __init__(self, failure_threshold: int = 5, reset_s: float = 5.0,
+                 clock=time.monotonic) -> None:
+        self.failure_threshold = failure_threshold
+        self.reset_s = reset_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def breaker(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = self._breakers[key] = CircuitBreaker(
+                    self.failure_threshold, self.reset_s,
+                    clock=self._clock, name=key)
+            return b
+
+    def is_open(self, key: str) -> bool:
+        with self._lock:
+            b = self._breakers.get(key)
+        return b.is_open() if b is not None else False
+
+    def open_count(self) -> int:
+        with self._lock:
+            bs = list(self._breakers.values())
+        return sum(1 for b in bs if b.is_open())
+
+    def prune(self, live) -> None:
+        """Forget breakers for departed workers: a rejoining worker
+        (same URL) starts with a clean slate, like its fresh session."""
+        with self._lock:
+            for key in list(self._breakers):
+                if key not in live:
+                    del self._breakers[key]
+
+    def snapshot(self) -> dict[str, str]:
+        with self._lock:
+            bs = dict(self._breakers)
+        return {k: b.state for k, b in bs.items()}
+
+
+class ClusterResilience:
+    """The node's resilience bundle: one retry policy + one breaker board,
+    built from :class:`~tfidf_tpu.utils.config.Config` knobs and shared by
+    every leader→worker RPC path."""
+
+    def __init__(self, config) -> None:
+        self.policy = RetryPolicy(
+            max_attempts=config.rpc_max_attempts,
+            base_delay_s=config.rpc_backoff_base_s,
+            max_delay_s=config.rpc_backoff_max_s,
+            deadline_s=config.rpc_retry_deadline_s)
+        self.board = BreakerBoard(
+            failure_threshold=config.breaker_failure_threshold,
+            reset_s=config.breaker_reset_s)
+
+    def worker_call(self, worker: str, fn, retry: bool = True):
+        """Run one logical RPC against ``worker`` under its breaker.
+
+        The breaker admits/rejects the WHOLE logical call; the retry
+        policy runs inside it, so a call that succeeds on attempt 2 of 3
+        counts as one breaker success, and only a call that exhausts its
+        retries counts as one breaker failure. Application rejections
+        (4xx) propagate without indicting the worker."""
+        b = self.board.breaker(worker)
+        b.acquire()
+        try:
+            out = self.policy.call(fn) if retry else fn()
+        except Exception as e:
+            if is_worker_fault(e):
+                b.record_failure()
+            else:
+                b.record_success()   # a 4xx proves the worker is alive
+            raise
+        b.record_success()
+        return out

@@ -57,10 +57,18 @@ def pack_hit_lists(results) -> bytes:
 def unpack_hit_lists(data: bytes) -> list[list[tuple[str, float]]]:
     """Decode :func:`pack_hit_lists` output into per-query
     ``[(name, score), ...]`` lists (request order)."""
+    # the wire contract is ValueError on ANY malformed buffer; without
+    # the up-front length checks a truncated reply surfaces as
+    # struct.error from unpack_from instead
+    if len(data) < _HEADER.size:
+        raise ValueError(
+            f"wire buffer too short for header: {len(data)} bytes")
     magic, n = _HEADER.unpack_from(data, 0)
     if magic != MAGIC:
         raise ValueError(f"bad wire magic {magic:#x}")
     off = _HEADER.size
+    if len(data) < off + 4 * n + _U32.size:
+        raise ValueError("wire buffer too short for counts")
     counts = np.frombuffer(data, np.uint32, count=n, offset=off)
     off += 4 * n
     (total,) = _U32.unpack_from(data, off)

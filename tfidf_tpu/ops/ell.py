@@ -40,6 +40,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# newer jax renamed TPUCompilerParams -> CompilerParams; resolve once so
+# the kernel wrapper below works on either
+_TPUCompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 from tfidf_tpu.ops.csr import CooShard, next_capacity
 from tfidf_tpu.ops.scoring import (QueryBatch, _compile_queries,
                                    bm25_weights, score_coo_compiled,
@@ -314,7 +319,7 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, rows_cap), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         # non-TPU backends (CPU tests, hypothetically GPU) run the
         # reference interpreter instead of lowering a Mosaic program

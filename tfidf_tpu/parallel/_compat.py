@@ -1,0 +1,20 @@
+"""jax version compatibility for the parallel layer.
+
+``jax.shard_map`` moved to the top-level namespace (and its ``check_rep``
+kwarg became ``check_vma``) only in newer jax; on older versions
+(< 0.4.38) it lives under ``jax.experimental.shard_map``. Resolve the
+difference once, here, so every call site is version-agnostic.
+"""
+
+from __future__ import annotations
+
+import jax
+
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:   # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, **kw):
+        # the experimental API spells check_vma as check_rep
+        kw["check_rep"] = kw.pop("check_vma", False)
+        return _exp_shard_map(f, **kw)

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tfidf_tpu.parallel._compat import shard_map as _shard_map
+
 from tfidf_tpu.ops.csr import next_capacity
 from tfidf_tpu.ops.ell import (_pallas_eligible, _score_block,
                                score_block_pallas, _rearrange_to_real)
@@ -252,7 +254,7 @@ def make_impact_refresh(mesh: Mesh, *, model: str = "bm25",
     def refresh(arrays: MeshEllArrays, df_g, n_docs, avgdl):
         import dataclasses
         k = arrays.n_buckets
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             step, mesh=mesh, in_specs=n_in(k),
             out_specs=(P("docs", None, "terms"),) * k,
             check_vma=False)
@@ -372,7 +374,7 @@ def make_mesh_ell_search(mesh: Mesh,
     def search(base: MeshEllArrays, delta, df_g, n_docs, avgdl,
                q: QueryBatch):
         nb = base.n_buckets
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             step, mesh=mesh, in_specs=in_specs(nb),
             out_specs=(P(), P()), check_vma=False)
         vals, gids = sharded(

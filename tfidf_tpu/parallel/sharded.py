@@ -36,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tfidf_tpu.parallel._compat import shard_map as _shard_map
+
 from tfidf_tpu.ops.csr import CooShard, next_capacity
 from tfidf_tpu.ops.scoring import (QueryBatch, cosine_norms,
                                    score_coo_impl)
@@ -291,7 +293,7 @@ def make_sharded_search(mesh: Mesh,
         top_vals, top_ids = merge_topk(all_vals, all_ids)
         return top_vals, top_ids
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         step,
         mesh=mesh,
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
@@ -372,7 +374,7 @@ def make_sharded_scores(mesh: Mesh,
         scores = jax.lax.psum(partial, "terms")
         return (scores * live[None, :])[None]           # [1, B, doc_cap]
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         step,
         mesh=mesh,
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),
@@ -552,7 +554,7 @@ def make_sharded_ingest(mesh: Mesh):
                 used2[None, None], live2[None],
                 (len_sum + new_len_sum)[None])
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         step,
         mesh=mesh,
         in_specs=(P("docs", "terms", None), P("docs", "terms", None),

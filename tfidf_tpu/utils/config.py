@@ -184,6 +184,30 @@ class Config:
     # tenure (a freshly promoted leader starts with an empty store).
     shard_recovery: bool = True
 
+    # --- resilience (cluster plane) ---
+    # Leader->worker RPC retry policy: bounded attempts with exponential
+    # backoff + jitter; only transient failures (connection-level, 5xx)
+    # are retried — see cluster/resilience.py. deadline 0 = attempts-only.
+    rpc_max_attempts: int = 3
+    rpc_backoff_base_s: float = 0.05
+    rpc_backoff_max_s: float = 2.0
+    rpc_retry_deadline_s: float = 10.0
+    # Per-worker circuit breaker: closed -> open after N consecutive
+    # failed logical RPCs -> one half-open probe after reset_s. An open
+    # breaker fast-fails scatter/placement calls to that worker (counted
+    # as degraded, never as a silent empty merge).
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 5.0
+    # Periodic leader sweep retrying failed rejoin reconciles
+    # (/worker/delete) so moved documents cannot stay double-indexed
+    # until the next membership event; pending names are excluded from
+    # that worker's merged results meanwhile. 0 disables the sweep.
+    reconcile_sweep_interval_s: float = 2.0
+    # Transient remote-compile retry: max retries charged per query-batch
+    # bucket size; a deterministic compile error (e.g. OOM at a new
+    # bucket) stops being retried once the bucket's budget is spent.
+    compile_retry_per_bucket: int = 2
+
     # --- ingest ---
     # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back
     # to the pure-Python analyzer when no compiler is available or for

@@ -6,6 +6,14 @@ scatter-gather, ``Leader.java:67-69``) but has no way to *inject* them
 named fault points are sprinkled through the control plane (worker RPC,
 heartbeat, checkpoint write) and a test/chaos harness can arm them to raise,
 delay, or drop with a given probability.
+
+Every fault point in the tree is declared in :data:`KNOWN_FAULT_POINTS`
+(``tfidf_tpu faults list`` prints it) so chaos configs can be validated
+against the code instead of silently going stale; a tier-1 test greps the
+sources and fails if a ``check()`` site is missing from the registry.
+Arming a name ending in ``*`` matches any point with that prefix (e.g.
+``coord.heartbeat.*`` covers the per-session server-side heartbeat
+points) — fires are counted under the wildcard rule's name.
 """
 
 from __future__ import annotations
@@ -14,6 +22,29 @@ import random
 import threading
 import time
 from dataclasses import dataclass
+
+# Registry of every fault point compiled into the tree: name -> where it
+# fires. Dynamic per-instance points are declared with a ``*`` suffix.
+KNOWN_FAULT_POINTS: dict[str, str] = {
+    "leader.worker_rpc": "leader scatter RPC to one worker "
+                         "(per-query and batched paths)",
+    "leader.size_poll": "leader polling one worker's /worker/index-size",
+    "leader.reconcile_rpc": "leader's /worker/delete rejoin-reconcile RPC",
+    "leader.sweep": "one reconciliation-sweep pass on the leader",
+    "worker.process": "worker handling /worker/process[-batch]",
+    "worker.upload": "worker handling /worker/upload[-batch]",
+    "coord.heartbeat.*": "coordination server receiving a session "
+                         "heartbeat (suffix: session id)",
+    "coord.heartbeat_send": "coordination client sending a heartbeat",
+    "coord.long_poll": "coordination client's event long-poll",
+    "resilience.backoff": "retry policy about to sleep a backoff delay",
+    "resilience.breaker_trip": "circuit breaker transitioning to open "
+                               "(observe-only: armed raise is swallowed)",
+    "resilience.breaker_probe": "circuit breaker admitting a half-open "
+                                "probe (observe-only)",
+    "checkpoint.pre_publish": "checkpoint written but not yet published "
+                              "(crash window)",
+}
 
 
 class FaultInjected(RuntimeError):
@@ -49,11 +80,22 @@ class FaultInjector:
             else:
                 self._rules.pop(point, None)
 
+    def _match(self, point: str) -> tuple[str, _Rule] | None:
+        """Exact rule first, then any armed ``prefix*`` wildcard."""
+        rule = self._rules.get(point)
+        if rule is not None:
+            return point, rule
+        for key, r in self._rules.items():
+            if key.endswith("*") and point.startswith(key[:-1]):
+                return key, r
+        return None
+
     def check(self, point: str) -> None:
         with self._lock:
-            rule = self._rules.get(point)
-            if rule is None:
+            hit = self._match(point)
+            if hit is None:
                 return
+            key, rule = hit
             if rule.remaining is not None:
                 if rule.remaining <= 0:
                     return
@@ -61,7 +103,9 @@ class FaultInjector:
                 return
             if rule.remaining is not None:
                 rule.remaining -= 1
-            self.fired[point] = self.fired.get(point, 0) + 1
+            # fires are counted under the RULE's name so wildcard chaos
+            # configs can assert totals without enumerating instances
+            self.fired[key] = self.fired.get(key, 0) + 1
             action, delay_s, fn = rule.action, rule.delay_s, rule.fn
         if action == "delay":
             time.sleep(delay_s)

@@ -39,6 +39,15 @@ class Metrics:
             t[2] = min(t[2], seconds)
             t[3] = max(t[3], seconds)
 
+    def get(self, name: str, default: float = 0.0) -> float:
+        """Read one counter/gauge (counters win on a name collision) —
+        the resilience paths and tests branch on live values without
+        paying for a full snapshot."""
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            return self._gauges.get(name, default)
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             out: dict[str, Any] = dict(self._counters)

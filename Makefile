@@ -1,22 +1,33 @@
 # Test / chaos job targets.
 #
-#   make test    tier-1: fast deterministic suite (what the driver runs);
-#                includes tests/test_resilience.py's deterministic subset
-#   make chaos   slow probabilistic chaos job: fault injection armed on
-#                worker RPCs, heartbeats, and reconciles
-#                (tests/test_resilience.py -m slow)
-#   make faults  list every registered fault point (chaos configs should
-#                be validated against this — see utils/faults.py)
+#   make test         tier-1: fast deterministic suite (what the driver
+#                     runs and .github/workflows/tier1.yml replicates);
+#                     includes the deterministic subsets of
+#                     tests/test_resilience.py and
+#                     tests/test_coordination_durability.py
+#   make chaos        slow probabilistic chaos job: fault injection armed
+#                     on worker RPCs, heartbeats, and reconciles
+#                     (tests/test_resilience.py -m slow)
+#   make chaos-coord  slow coordination-durability chaos job: SIGKILL +
+#                     restart of substrate members (subprocess
+#                     coordinators) mid-traffic
+#                     (tests/test_coordination_durability.py -m slow)
+#   make faults       list every registered fault point (chaos configs
+#                     should be validated against this — see
+#                     utils/faults.py)
 
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test chaos faults bench
+.PHONY: test chaos chaos-coord faults bench
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
 
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py $(PYTEST_FLAGS) -m slow
+
+chaos-coord:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_coordination_durability.py $(PYTEST_FLAGS) -m slow
 
 faults:
 	python -m tfidf_tpu faults list

@@ -1182,6 +1182,48 @@ def bench_5m_vocab(rng) -> dict:
             "vocab": C5_VOCAB}
 
 
+def _emit_validated(result: dict) -> None:
+    """Artifact self-validation: the committed ``BENCH_r05.json`` ended
+    up with ``"parsed": null`` and a cut-off tail (see BASELINE.md) —
+    a silently truncated artifact. Serialize, re-parse the exact bytes
+    about to be emitted, check the required keys, and (when ``BENCH_OUT``
+    names a file) write + re-read + re-parse the file too, failing
+    LOUDLY with exit 1 instead of leaving a broken artifact behind."""
+    line = json.dumps(result)
+    try:
+        back = json.loads(line)
+    except ValueError as e:
+        print(f"BENCH SELF-VALIDATION FAILED: result does not re-parse: "
+              f"{e}", file=sys.stderr)
+        sys.exit(1)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        if key not in back:
+            print(f"BENCH SELF-VALIDATION FAILED: missing key {key!r}",
+                  file=sys.stderr)
+            sys.exit(1)
+    if not isinstance(back["value"], (int, float)):
+        print("BENCH SELF-VALIDATION FAILED: 'value' is not numeric",
+              file=sys.stderr)
+        sys.exit(1)
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            with open(out_path, encoding="utf-8") as f:
+                if json.loads(f.read()) != back:
+                    raise ValueError("file round-trip mismatch")
+        except (ValueError, OSError) as e:
+            print(f"BENCH SELF-VALIDATION FAILED: re-reading {out_path!r}: "
+                  f"{e}", file=sys.stderr)
+            sys.exit(1)
+        print(f"bench artifact validated: {out_path}", file=sys.stderr)
+    print(line)
+    sys.stdout.flush()
+
+
 def main() -> None:
     rng = np.random.default_rng(SEED)
     # FIRST, before this process touches jax: the TPU-backed cluster
@@ -1236,7 +1278,7 @@ def main() -> None:
             "top_k": TOP_K,
         },
     }
-    print(json.dumps(result))
+    _emit_validated(result)
 
 
 if __name__ == "__main__":

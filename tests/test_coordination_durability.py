@@ -1,0 +1,576 @@
+"""Coordination durability + quorum (ISSUE 2): WAL, snapshots, ensemble.
+
+Acceptance bar (ISSUE 2):
+
+- a single crashed coordinator restarted from ``--data-dir`` recovers
+  the full znode tree and sessions (crash-restart differential vs a
+  never-crashed oracle core);
+- a 3-member ensemble survives the kill of any single member —
+  including the leader — with zero lost acknowledged writes, and
+  election/registry/watch semantics survive for clients (multi-address
+  failover + watch re-arm);
+- a write that cannot reach quorum fails LOUDLY (it is never silently
+  acknowledged).
+
+The deterministic subset runs in tier-1. The SIGKILL chaos jobs (real
+``python -m tfidf_tpu coordinator`` subprocesses killed mid-traffic) are
+marked ``slow`` (``make chaos-coord``).
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from tfidf_tpu.cluster.coordination import (
+    CHILDREN_CHANGED, EPHEMERAL, CoordinationClient, CoordinationCore,
+    CoordinationServer, CoordinationUnavailable, NoNodeError)
+from tfidf_tpu.cluster.wal import DurableStore, decode_frames, encode_frame
+from tfidf_tpu.utils.faults import global_injector
+
+from tests.test_cluster import wait_until
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+# ---------------------------------------------------------------------------
+# WAL framing + DurableStore recovery
+# ---------------------------------------------------------------------------
+
+class TestWAL:
+    def test_frame_roundtrip(self):
+        frames = [encode_frame(f"payload-{i}".encode()) for i in range(5)]
+        payloads, clean = decode_frames(b"".join(frames))
+        assert payloads == [f"payload-{i}".encode() for i in range(5)]
+        assert clean == sum(map(len, frames))
+
+    def test_torn_tail_truncated(self):
+        blob = encode_frame(b"good") + encode_frame(b"torn")[:-2]
+        payloads, clean = decode_frames(blob)
+        assert payloads == [b"good"]
+        assert clean == len(encode_frame(b"good"))
+
+    def test_corrupt_crc_stops_replay(self):
+        good = encode_frame(b"good")
+        bad = bytearray(encode_frame(b"evil"))
+        bad[-1] ^= 0xFF
+        payloads, clean = decode_frames(good + bytes(bad)
+                                        + encode_frame(b"after"))
+        assert payloads == [b"good"]     # nothing past the corruption
+        assert clean == len(good)
+
+    def test_store_append_load_roundtrip(self, tmp_path):
+        st = DurableStore(str(tmp_path))
+        entries = [{"i": i + 1, "t": 1, "c": {"op": "noop", "n": i}}
+                   for i in range(10)]
+        st.append(entries[:4])
+        st.append(entries[4:])
+        st.close()
+        meta, snap, got = DurableStore(str(tmp_path)).load()
+        assert meta == {"term": 0, "voted_for": None}
+        assert snap is None
+        assert got == entries
+
+    def test_store_truncates_torn_tail_on_disk(self, tmp_path):
+        st = DurableStore(str(tmp_path))
+        st.append([{"i": 1, "t": 1, "c": {"op": "noop"}}])
+        st.close()
+        wal = tmp_path / "wal.log"
+        blob = wal.read_bytes()
+        wal.write_bytes(blob + encode_frame(b"{}")[:-3])   # torn append
+        st2 = DurableStore(str(tmp_path))
+        _, _, got = st2.load()
+        assert [e["i"] for e in got] == [1]
+        st2.close()
+        assert wal.read_bytes() == blob    # file physically truncated
+
+    def test_snapshot_compacts_wal(self, tmp_path):
+        st = DurableStore(str(tmp_path))
+        entries = [{"i": i + 1, "t": 2, "c": {"op": "noop"}}
+                   for i in range(6)]
+        st.append(entries)
+        state = {"next_sid": 7, "tree": {}, "sessions": {}}
+        st.save_snapshot(state, 4, 2, entries[4:])
+        st.close()
+        meta, snap, got = DurableStore(str(tmp_path)).load()
+        assert snap["last_index"] == 4 and snap["last_term"] == 2
+        assert snap["state"] == state
+        assert [e["i"] for e in got] == [5, 6]
+
+    def test_meta_persisted(self, tmp_path):
+        st = DurableStore(str(tmp_path))
+        st.set_meta(7, "c2")
+        st.close()
+        meta, _, _ = DurableStore(str(tmp_path)).load()
+        assert meta == {"term": 7, "voted_for": "c2"}
+
+    def test_failed_fsync_rewinds_so_index_reuse_is_safe(self, tmp_path):
+        """A failed append must leave NO frame behind: the unacked
+        entry's index is reused by the next write, and a leftover
+        duplicate-index frame would make recovery's index-continuity
+        check truncate ACKED history after it."""
+        st = DurableStore(str(tmp_path))
+        st.append([{"i": 1, "t": 1, "c": {"op": "noop"}}])
+        global_injector.arm("wal.fsync", action="raise", times=1)
+        with pytest.raises(Exception):
+            st.append([{"i": 2, "t": 1, "c": {"op": "noop",
+                                              "v": "never-acked"}}])
+        global_injector.disarm()
+        st.append([{"i": 2, "t": 1, "c": {"op": "noop", "v": "acked"}}])
+        st.append([{"i": 3, "t": 1, "c": {"op": "noop"}}])
+        st.close()
+        _, _, got = DurableStore(str(tmp_path)).load()
+        assert [e["i"] for e in got] == [1, 2, 3]
+        assert got[1]["c"]["v"] == "acked"
+
+    def test_wal_append_fault_fails_write_loudly(self, tmp_path):
+        """An armed wal.append means the write is NOT acknowledged —
+        and NOT durable."""
+        st = DurableStore(str(tmp_path))
+        global_injector.arm("wal.append", action="raise")
+        with pytest.raises(Exception):
+            st.append([{"i": 1, "t": 1, "c": {"op": "noop"}}])
+        global_injector.disarm()
+        st.close()
+        _, _, got = DurableStore(str(tmp_path)).load()
+        assert got == []
+
+
+# ---------------------------------------------------------------------------
+# Durable standalone: crash-restart differential vs oracle
+# ---------------------------------------------------------------------------
+
+def _traffic(coord, core_oracle=None):
+    """Apply a deterministic op mix through ``coord`` and mirror it on
+    the oracle core (same command order -> same state, by the apply-log
+    determinism contract)."""
+    sid = core_oracle.new_session() if core_oracle is not None else None
+    coord.create("/app", b"root")
+    coord.create("/app/cfg", b"v1")
+    coord.set_data("/app/cfg", b"v2")
+    for i in range(8):
+        coord.create(f"/app/item{i}", str(i).encode())
+    coord.delete("/app/item3")
+    coord.create("/eph", b"mine", mode=EPHEMERAL)
+    if core_oracle is not None:
+        core_oracle.create(sid, "/app", b"root")
+        core_oracle.create(sid, "/app/cfg", b"v1")
+        core_oracle.set_data(sid, "/app/cfg", b"v2")
+        for i in range(8):
+            core_oracle.create(sid, f"/app/item{i}", str(i).encode())
+        core_oracle.delete(sid, "/app/item3")
+        core_oracle.create(sid, "/eph", b"mine", mode=EPHEMERAL)
+
+
+class TestDurableRestart:
+    def test_crash_restart_matches_oracle(self, tmp_path):
+        """Hard-kill the durable coordinator mid-traffic and restart it
+        from WAL+snapshot: the recovered znode tree, registry of
+        ephemerals, and session table must equal a never-crashed oracle
+        core that applied the same commands."""
+        data = str(tmp_path / "coord")
+        port = free_ports(1)[0]
+        srv = CoordinationServer(host="127.0.0.1", port=port,
+                                 session_timeout_s=30.0, data_dir=data,
+                                 snapshot_every=5).start()
+        oracle = CoordinationCore(session_timeout_s=30.0)
+        try:
+            cli = CoordinationClient(srv.address, heartbeat_interval_s=1.0)
+            _traffic(cli, oracle)
+            srv.kill()    # no graceful flush: recovery is WAL-only
+            srv2 = CoordinationServer(host="127.0.0.1", port=port,
+                                      session_timeout_s=30.0,
+                                      data_dir=data).start()
+            try:
+                assert wait_until(
+                    lambda: srv2.ensemble.is_leader(), timeout=10.0)
+                assert srv2.core.state_snapshot() == \
+                    oracle.state_snapshot()
+                # the surviving client reconnects into its old session:
+                # its ephemeral znode is still owned and readable
+                assert cli.get_data("/eph") == b"mine"
+                assert cli.get_data("/app/cfg") == b"v2"
+                assert not cli.exists("/app/item3")
+            finally:
+                cli.close()
+                srv2.close()
+        finally:
+            oracle.close()
+
+    def test_watch_survives_same_address_restart(self, tmp_path):
+        """restore_state wipes the server-side watch table; the client
+        must re-arm on its old host:port after the coordinator restarts
+        (not only after failing over to a DIFFERENT address) — else
+        election/registry watches silently die with the substrate."""
+        data = str(tmp_path / "coord")
+        port = free_ports(1)[0]
+        srv = CoordinationServer(host="127.0.0.1", port=port,
+                                 session_timeout_s=30.0,
+                                 data_dir=data).start()
+        cli = CoordinationClient(srv.address, heartbeat_interval_s=0.5)
+        cli2 = CoordinationClient(srv.address, heartbeat_interval_s=0.5)
+        cli.create("/w", b"")
+        events = []
+        cli.get_children("/w", watcher=events.append)
+        srv.kill()
+        srv2 = CoordinationServer(host="127.0.0.1", port=port,
+                                  session_timeout_s=30.0,
+                                  data_dir=data).start()
+        try:
+            assert wait_until(lambda: srv2.ensemble.is_leader(),
+                              timeout=10.0)
+            cli2.create("/w/x", b"1")    # change lands POST-restart
+            assert wait_until(lambda: len(events) >= 1, timeout=15.0)
+            assert events[0].type == CHILDREN_CHANGED
+            assert events[0].path == "/w"
+        finally:
+            cli.close()
+            cli2.close()
+            srv2.close()
+
+    def test_restart_uses_snapshot_plus_tail(self, tmp_path):
+        """snapshot_every=5 forces compaction mid-traffic: recovery must
+        stitch snapshot state + WAL tail, not just replay a full log."""
+        data = str(tmp_path / "coord")
+        srv = CoordinationServer(port=0, session_timeout_s=30.0,
+                                 data_dir=data, snapshot_every=5).start()
+        cli = CoordinationClient(srv.address, heartbeat_interval_s=1.0)
+        _traffic(cli)
+        before = srv.core.state_snapshot()
+        # a snapshot happened (>=14 commands applied at every-5 cadence)
+        assert srv.ensemble.base_index > 0
+        srv.kill()
+        meta, snap, tail = DurableStore(data).load()
+        assert snap is not None and snap["last_index"] > 0
+        srv2 = CoordinationServer(port=0, session_timeout_s=30.0,
+                                  data_dir=data).start()
+        try:
+            assert wait_until(lambda: srv2.ensemble.is_leader(),
+                              timeout=10.0)
+            assert srv2.core.state_snapshot() == before
+        finally:
+            cli.close()
+            srv2.close()
+
+
+# ---------------------------------------------------------------------------
+# Replicated ensemble (in-process members; kill = crash simulation)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def ensemble3(tmp_path):
+    ports = free_ports(3)
+    peers = {f"c{i}": f"127.0.0.1:{p}" for i, p in enumerate(ports)}
+    servers = {}
+    for i, p in enumerate(ports):
+        servers[f"c{i}"] = CoordinationServer(
+            host="127.0.0.1", port=p, session_timeout_s=20.0,
+            data_dir=str(tmp_path / f"c{i}"), node_id=f"c{i}",
+            peers=dict(peers), election_timeout_s=0.4,
+            heartbeat_interval_s=0.1, commit_timeout_s=3.0,
+            snapshot_every=64).start()
+    yield peers, servers
+    for s in servers.values():
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+def wait_leader(servers, timeout=15.0):
+    """Wait for exactly one live member to hold leadership."""
+    box = {}
+
+    def one_leader():
+        leaders = [nid for nid, s in servers.items()
+                   if s.ensemble.is_leader()]
+        box["leaders"] = leaders
+        return len(leaders) == 1
+
+    assert wait_until(one_leader, timeout=timeout), \
+        f"no unique leader: {[s.ensemble.status() for s in servers.values()]}"
+    return box["leaders"][0]
+
+
+class TestEnsemble:
+    def test_leader_kill_loses_no_acked_write(self, ensemble3):
+        peers, servers = ensemble3
+        leader = wait_leader(servers)
+        cli = CoordinationClient(",".join(peers.values()),
+                                 heartbeat_interval_s=0.5)
+        acked = []
+        for k in range(12):
+            cli.create(f"/k{k}", str(k).encode())
+            acked.append(f"/k{k}")
+        servers[leader].kill()
+        survivors = {n: s for n, s in servers.items() if n != leader}
+        wait_leader(survivors)
+        # every acknowledged write survives the leader's death
+        for p in acked:
+            assert cli.exists(p), f"lost acknowledged write {p}"
+        assert cli.get_data("/k7") == b"7"
+        # the surviving majority keeps accepting writes
+        cli.create("/after-failover", b"ok")
+        assert cli.get_data("/after-failover") == b"ok"
+        # and the client session survived the failover (same sid)
+        assert cli._rpc({"op": "heartbeat"}).get("ok") is True
+        cli.close()
+
+    def test_follower_kill_is_invisible(self, ensemble3):
+        peers, servers = ensemble3
+        leader = wait_leader(servers)
+        follower = next(n for n in servers if n != leader)
+        cli = CoordinationClient(",".join(peers.values()),
+                                 heartbeat_interval_s=0.5)
+        cli.create("/pre", b"1")
+        servers[follower].kill()
+        for k in range(8):
+            cli.create(f"/f{k}", str(k).encode())
+        assert all(cli.exists(f"/f{k}") for k in range(8))
+        assert servers[leader].ensemble.is_leader()
+        cli.close()
+
+    def test_follower_redirects_writes_to_leader(self, ensemble3):
+        peers, servers = ensemble3
+        leader = wait_leader(servers)
+        follower = next(n for n in servers if n != leader)
+        # client configured with ONLY the follower's address: the 421
+        # not_leader hint must carry it to the leader transparently
+        cli = CoordinationClient(peers[follower],
+                                 heartbeat_interval_s=0.5)
+        cli.create("/via-follower", b"x")
+        assert servers[leader].core.exists(0, "/via-follower")
+        cli.close()
+
+    def test_watches_survive_leader_failover(self, ensemble3):
+        peers, servers = ensemble3
+        leader = wait_leader(servers)
+        cli = CoordinationClient(",".join(peers.values()),
+                                 heartbeat_interval_s=0.5)
+        cli2 = CoordinationClient(",".join(peers.values()),
+                                  heartbeat_interval_s=0.5)
+        cli.create("/watched", b"")
+        events = []
+        cli.get_children("/watched", watcher=events.append)
+        servers[leader].kill()
+        survivors = {n: s for n, s in servers.items() if n != leader}
+        wait_leader(survivors)
+        cli2.create("/watched/x", b"1")   # change lands on the NEW leader
+        assert wait_until(lambda: len(events) >= 1, timeout=15.0)
+        assert events[0].type == CHILDREN_CHANGED
+        assert events[0].path == "/watched"
+        cli.close()
+        cli2.close()
+
+    def test_session_expiry_replicated_from_leader_clock(self, tmp_path):
+        """Ephemeral cleanup is a LOGGED command from the leader's
+        clock: every replica drops the dead session's znodes."""
+        ports = free_ports(3)
+        peers = {f"c{i}": f"127.0.0.1:{p}" for i, p in enumerate(ports)}
+        servers = {}
+        for i, p in enumerate(ports):
+            servers[f"c{i}"] = CoordinationServer(
+                host="127.0.0.1", port=p, session_timeout_s=1.0,
+                data_dir=str(tmp_path / f"s{i}"), node_id=f"c{i}",
+                peers=dict(peers), election_timeout_s=0.4,
+                heartbeat_interval_s=0.1, commit_timeout_s=3.0).start()
+        try:
+            wait_leader(servers)
+            cli = CoordinationClient(",".join(peers.values()),
+                                     heartbeat_interval_s=0.2)
+            cli.create("/svc", b"")
+            cli.create("/svc/me", b"addr", mode=EPHEMERAL)
+            cli._closed.set()      # stop heartbeats: simulate a dead node
+            assert wait_until(
+                lambda: all(not s.core.exists(0, "/svc/me")
+                            for s in servers.values()), timeout=15.0)
+        finally:
+            for s in servers.values():
+                s.close()
+
+    def test_no_quorum_write_fails_loudly(self, ensemble3):
+        """With replication to BOTH peers failing, the leader must not
+        acknowledge — the submit raises instead of lying. Either honest
+        failure is acceptable: commit timeout (CoordinationUnavailable)
+        or deposition by the cut-off peers' new election
+        (NotLeaderError) — what is FORBIDDEN is a silent ack."""
+        from tfidf_tpu.cluster.coordination import NotLeaderError
+        peers, servers = ensemble3
+        leader = wait_leader(servers)
+        ens = servers[leader].ensemble
+        global_injector.arm("ensemble.replicate_append.*", action="raise")
+        try:
+            with pytest.raises((CoordinationUnavailable, NotLeaderError)):
+                ens.submit({"op": "create", "sid": 0,
+                            "path": "/never-acked", "data": "",
+                            "mode": "persistent"})
+        finally:
+            global_injector.disarm()
+        # the entry may exist in the leader's log, but it was never
+        # acknowledged; after healing, the cluster still works
+        cli = CoordinationClient(",".join(peers.values()),
+                                 heartbeat_interval_s=0.5)
+        cli.create("/healed", b"1")
+        assert cli.get_data("/healed") == b"1"
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL chaos: real coordinator subprocesses killed mid-traffic (slow)
+# ---------------------------------------------------------------------------
+
+def _spawn_coordinator(port, data_dir, node_id="", peers="", env=None):
+    cmd = [sys.executable, "-m", "tfidf_tpu", "coordinator",
+           "--listen", f"127.0.0.1:{port}", "--data-dir", data_dir]
+    if node_id:
+        cmd += ["--node-id", node_id]
+    if peers:
+        cmd += ["--peers", peers]
+    full_env = dict(os.environ,
+                    JAX_PLATFORMS="cpu",
+                    TFIDF_SESSION_TIMEOUT_S="30",
+                    TFIDF_ENSEMBLE_ELECTION_TIMEOUT_S="0.4",
+                    TFIDF_ENSEMBLE_HEARTBEAT_S="0.1",
+                    TFIDF_ENSEMBLE_COMMIT_TIMEOUT_S="3.0")
+    full_env.update(env or {})
+    return subprocess.Popen(cmd, env=full_env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_http(port, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    url = f"http://127.0.0.1:{port}/ensemble/status"
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=1.0) as r:
+                json.loads(r.read())
+            return True
+        except Exception:
+            time.sleep(0.1)
+    return False
+
+
+def _wait_subprocess_leader(ports, timeout=30.0):
+    """Poll /ensemble/status across live members until one is leader."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for p in ports:
+            try:
+                url = f"http://127.0.0.1:{p}/ensemble/status"
+                with urllib.request.urlopen(url, timeout=1.0) as r:
+                    if json.loads(r.read()).get("role") == "leader":
+                        return True
+            except Exception:
+                continue
+        time.sleep(0.1)
+    return False
+
+
+@pytest.mark.slow
+class TestSigkillChaos:
+    def test_sigkill_restart_differential(self, tmp_path):
+        """The ISSUE's crash-restart differential, with a REAL SIGKILL:
+        kill -9 the coordinator subprocess mid-traffic, restart it on
+        the same --data-dir, and assert the recovered tree equals the
+        never-crashed oracle core's."""
+        port = free_ports(1)[0]
+        data = str(tmp_path / "solo")
+        proc = _spawn_coordinator(port, data)
+        try:
+            assert _wait_http(port)
+            oracle = CoordinationCore(session_timeout_s=60.0)
+            cli = CoordinationClient(f"127.0.0.1:{port}",
+                                     heartbeat_interval_s=1.0)
+            _traffic(cli, oracle)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            proc = _spawn_coordinator(port, data)
+            assert _wait_http(port)
+            # read the whole tree back through the recovered server
+            def tree(coord, path):
+                kids = sorted(coord.get_children(path))
+                base = "" if path == "/" else path
+                return {k: (coord.get_data(f"{base}/{k}").hex(),
+                            tree(coord, f"{base}/{k}")) for k in kids}
+            got = tree(cli, "/")
+            oracle_cli_sid = 0
+            def otree(path):
+                kids = sorted(oracle.get_children(oracle_cli_sid, path))
+                base = "" if path == "/" else path
+                return {k: (oracle.get_data(oracle_cli_sid,
+                                            f"{base}/{k}").hex(),
+                            otree(f"{base}/{k}")) for k in kids}
+            assert got == otree("/")
+            # sessions recovered too: the pre-kill session still owns
+            # its ephemeral node
+            assert cli.get_data("/eph") == b"mine"
+            cli.close()
+            oracle.close()
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def test_ensemble_sigkill_rolling_chaos(self, tmp_path):
+        """Kill -9 each ensemble member in turn (leader included) while
+        a writer keeps appending; every acknowledged write must be
+        readable at the end, and restarted members catch back up."""
+        ports = free_ports(3)
+        peers = ",".join(f"c{i}=127.0.0.1:{p}"
+                         for i, p in enumerate(ports))
+        dirs = {i: str(tmp_path / f"m{i}") for i in range(3)}
+        procs = {}
+        for i, p in enumerate(ports):
+            procs[i] = _spawn_coordinator(p, dirs[i], node_id=f"c{i}",
+                                          peers=peers)
+        try:
+            for p in ports:
+                assert _wait_http(p)
+            assert _wait_subprocess_leader(ports)
+            connect = ",".join(f"127.0.0.1:{p}" for p in ports)
+            cli = CoordinationClient(connect, heartbeat_interval_s=1.0)
+            acked = []
+
+            def write_burst(tag, n=10):
+                for k in range(n):
+                    path = f"/chaos-{tag}-{k}"
+                    cli.create(path, tag.encode())
+                    acked.append(path)
+
+            write_burst("warmup")
+            for round_no in range(3):
+                victim = round_no % 3
+                os.kill(procs[victim].pid, signal.SIGKILL)
+                procs[victim].wait(timeout=10)
+                write_burst(f"r{round_no}")       # quorum of 2 serves
+                procs[victim] = _spawn_coordinator(
+                    ports[victim], dirs[victim], node_id=f"c{victim}",
+                    peers=peers)
+                assert _wait_http(ports[victim])
+                write_burst(f"r{round_no}b")
+            for path in acked:
+                assert cli.exists(path), f"lost acknowledged {path}"
+            cli.close()
+        finally:
+            for proc in procs.values():
+                proc.kill()
+                proc.wait(timeout=10)

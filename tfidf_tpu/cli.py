@@ -65,12 +65,47 @@ def cmd_serve(args) -> int:
                         if cfg.dist_process_id >= 0 else None))
     server = None
     if args.embedded_coordinator:
-        host, _, port = cfg.coordinator_address.partition(":")
+        if cfg.coord_peers and not cfg.coord_data_dir:
+            print("TFIDF_COORD_PEERS requires TFIDF_COORD_DATA_DIR "
+                  "(quorum state must be durable)", file=sys.stderr)
+            return 2
+        try:
+            peers = parse_peers(cfg.coord_peers)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if peers:
+            # ensemble member: bind THIS member's port from the peer
+            # map (the connect string lists every member — its first
+            # entry is usually someone else's address)
+            if cfg.coord_node_id not in peers:
+                print(f"TFIDF_COORD_NODE_ID {cfg.coord_node_id!r} "
+                      "missing from TFIDF_COORD_PEERS map",
+                      file=sys.stderr)
+                return 2
+            host = "0.0.0.0"
+            port = peers[cfg.coord_node_id].rsplit(":", 1)[1]
+        else:
+            host, _, port = (
+                cfg.coordinator_address.split(",")[0].strip()
+                .partition(":"))
         server = CoordinationServer(
             host=host or "127.0.0.1", port=int(port or 0),
-            session_timeout_s=cfg.session_timeout_s).start()
-        cfg = cfg.replace(coordinator_address=server.address)
-        log.info("embedded coordination service", address=server.address)
+            session_timeout_s=cfg.session_timeout_s,
+            data_dir=cfg.coord_data_dir or None,
+            node_id=cfg.coord_node_id,
+            peers=peers,
+            election_timeout_s=cfg.ensemble_election_timeout_s,
+            heartbeat_interval_s=cfg.ensemble_heartbeat_s,
+            commit_timeout_s=cfg.ensemble_commit_timeout_s,
+            snapshot_every=cfg.wal_snapshot_every,
+            wal_fsync=cfg.wal_fsync).start()
+        if not peers:
+            # standalone: the node talks to its own embedded service;
+            # ensemble members keep the full multi-member connect string
+            cfg = cfg.replace(coordinator_address=server.address)
+        log.info("embedded coordination service", address=server.address,
+                 durable=bool(cfg.coord_data_dir))
 
     def factory():
         return CoordinationClient(
@@ -131,15 +166,61 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def parse_peers(spec: str) -> dict[str, str]:
+    """``"c0=host0:2181,c1=host1:2181"`` -> ``{"c0": "host0:2181", ...}``
+    (the full ensemble member map, including this member)."""
+    peers: dict[str, str] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        nid, sep, addr = part.partition("=")
+        addr = addr.strip()
+        host, psep, port = addr.rpartition(":")
+        if (not sep or not nid.strip() or not host
+                or not psep or not port.isdigit()):
+            raise ValueError(f"bad peer spec {part!r} "
+                             "(expected id=host:port)")
+        peers[nid.strip()] = addr
+    return peers
+
+
 def cmd_coordinator(args) -> int:
     from tfidf_tpu.cluster.coordination import CoordinationServer
 
     cfg = _load_cfg(args)
-    host, _, port = (args.listen or "0.0.0.0:2181").partition(":")
+    data_dir = args.data_dir or cfg.coord_data_dir or None
+    node_id = args.node_id or cfg.coord_node_id
+    try:
+        peers = parse_peers(args.peers or cfg.coord_peers)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if peers and not data_dir:
+        print("--peers requires --data-dir (quorum state must be durable)",
+              file=sys.stderr)
+        return 2
+    if peers and node_id not in peers:
+        print(f"--node-id {node_id!r} missing from --peers map",
+              file=sys.stderr)
+        return 2
+    listen = args.listen
+    if not listen and node_id in peers:
+        # default to this member's advertised port from the peer map
+        listen = "0.0.0.0:" + peers[node_id].rsplit(":", 1)[1]
+    host, _, port = (listen or "0.0.0.0:2181").partition(":")
     server = CoordinationServer(
         host=host, port=int(port or 2181),
-        session_timeout_s=cfg.session_timeout_s).start()
-    print(f"coordination service at {server.address}", flush=True)
+        session_timeout_s=cfg.session_timeout_s,
+        data_dir=data_dir, node_id=node_id, peers=peers,
+        election_timeout_s=cfg.ensemble_election_timeout_s,
+        heartbeat_interval_s=cfg.ensemble_heartbeat_s,
+        commit_timeout_s=cfg.ensemble_commit_timeout_s,
+        snapshot_every=cfg.wal_snapshot_every,
+        wal_fsync=cfg.wal_fsync).start()
+    mode = ("ensemble member" if peers
+            else "durable" if data_dir else "in-memory")
+    print(f"coordination service at {server.address} ({mode})", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
@@ -362,7 +443,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("coordinator", help="run the coordination service")
-    s.add_argument("--listen", help="host:port (default 0.0.0.0:2181)")
+    s.add_argument("--listen", help="host:port (default 0.0.0.0:2181, or "
+                                    "this member's port from --peers)")
+    s.add_argument("--data-dir",
+                   help="durable state dir (WAL + snapshots); a restarted "
+                        "coordinator recovers its full znode tree and "
+                        "sessions from it")
+    s.add_argument("--node-id", help="this ensemble member's id")
+    s.add_argument("--peers",
+                   help="full ensemble member map incl. self: "
+                        "id0=host0:2181,id1=host1:2181,id2=host2:2181 "
+                        "(majority quorum commits every write)")
     s.set_defaults(fn=cmd_coordinator)
 
     s = sub.add_parser("ingest", help="index files/dirs locally")

@@ -18,6 +18,13 @@ The TPU-native replacement for the reference's coordination stack
 - :mod:`resilience` — the failure discipline shared by every
   leader->worker RPC path: bounded retry with backoff + jitter, and
   per-worker circuit breakers (closed/open/half-open).
+- :mod:`wal` — L0 durability: CRC-framed write-ahead log, atomic
+  snapshots of the znode tree + session table, and log compaction, so a
+  crashed coordinator restarts with its full state.
+- :mod:`ensemble` — L0 replication: Raft-style terms/votes/quorum-commit
+  over the WAL, turning the substrate into a 3-replica ensemble that
+  survives the loss of any single member with zero lost acknowledged
+  writes (the role ZooKeeper's ensemble plays for the reference).
 """
 
 from tfidf_tpu.cluster.coordination import (CoordinationCore,
@@ -29,10 +36,12 @@ from tfidf_tpu.cluster.registry import ServiceRegistry
 from tfidf_tpu.cluster.resilience import (BreakerBoard, CircuitBreaker,
                                           CircuitOpenError, RetryPolicy)
 from tfidf_tpu.cluster.node import SearchNode
+from tfidf_tpu.cluster.wal import DurableStore
+from tfidf_tpu.cluster.ensemble import EnsembleNode
 
 __all__ = [
     "CoordinationCore", "CoordinationServer", "CoordinationClient",
     "LocalCoordination", "Event", "LeaderElection", "OnElectionCallback",
     "ServiceRegistry", "SearchNode", "RetryPolicy", "CircuitBreaker",
-    "CircuitOpenError", "BreakerBoard",
+    "CircuitOpenError", "BreakerBoard", "DurableStore", "EnsembleNode",
 ]

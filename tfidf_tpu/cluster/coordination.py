@@ -70,6 +70,19 @@ class NoNodeError(Exception):
     pass
 
 
+class NotLeaderError(Exception):
+    """Raised on a write/read addressed to an ensemble follower; carries
+    the current leader's address (or None) as a failover hint."""
+
+    def __init__(self, leader: str | None = None) -> None:
+        super().__init__(f"not the ensemble leader (leader={leader})")
+        self.leader = leader
+
+
+class CoordinationUnavailable(Exception):
+    """No quorum / commit timed out — the write was NOT acknowledged."""
+
+
 class _Znode:
     __slots__ = ("data", "ephemeral_owner", "seq", "children")
 
@@ -87,6 +100,10 @@ class _Session:
     def __init__(self, sid: int) -> None:
         self.id = sid
         self.last_seen = time.monotonic()
+        # unbounded on purpose: an evicted event would be a one-shot
+        # watch fire lost forever (the registration was consumed).
+        # Ensemble followers don't accumulate here — they redirect all
+        # client reads, so their watch tables stay empty.
         self.queue: deque[Event] = deque()
         self.cond = threading.Condition()
         self.ephemerals: set[str] = set()
@@ -101,7 +118,16 @@ def _split(path: str) -> list[str]:
 
 
 class CoordinationCore:
-    """The znode tree. Thread-safe; transport-agnostic.
+    """The znode tree as a **deterministic apply-log state machine**.
+
+    Every mutation is a command dict (JSON-serializable) routed through
+    :meth:`_submit`; the default submit applies locally, and the ensemble
+    layer (``cluster/ensemble.py``) overrides it to append the command to
+    a replicated WAL and apply it only after quorum commit. :meth:`apply`
+    is deterministic — identical command sequences produce identical
+    :meth:`state_snapshot` results on every replica (the Raft state-
+    machine contract). Reads, heartbeats, watches, and event queues stay
+    node-local (they are not state).
 
     Watches are one-shot, exactly like ZooKeeper's: registering happens as a
     side effect of a read (``exists``/``get_children``), firing consumes the
@@ -118,22 +144,101 @@ class CoordinationCore:
         # (path, kind) -> set of session ids; kind: "exists" | "children"
         self._watches: dict[tuple[str, str], set[int]] = {}
         self._closed = False
+        # mutation route: standalone applies directly; the ensemble
+        # replaces this with quorum-replicated append-then-apply
+        self._submit: Callable[[dict], object] = self.apply
+        # session-expiry clock gate: only the ensemble LEADER may expire
+        # (followers apply the leader's expire_session log entries)
+        self.expiry_enabled: Callable[[], bool] = lambda: True
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True,
                                         name="coord-reaper")
         self._reaper.start()
 
+    # ---- the deterministic state machine ----
+
+    def apply(self, cmd: dict) -> object:
+        """Apply one committed command. Deterministic: same state + same
+        command -> same new state and same result/exception on every
+        replica. Watch/event side effects are local-only."""
+        op = cmd["op"]
+        with self._lock:
+            if op == "create":
+                return self._apply_create(
+                    cmd["sid"], cmd["path"],
+                    bytes.fromhex(cmd.get("data", "")),
+                    cmd.get("mode", PERSISTENT))
+            if op == "delete":
+                self._delete_locked(cmd["path"])
+                return None
+            if op == "set_data":
+                self._resolve(_split(cmd["path"])).data = \
+                    bytes.fromhex(cmd.get("data", ""))
+                return None
+            if op == "new_session":
+                sid = self._next_sid
+                self._next_sid += 1
+                self._sessions[sid] = _Session(sid)
+                return sid
+            if op in ("close_session", "expire_session"):
+                self._expire_locked(cmd["sid"],
+                                    reason=cmd.get("reason", op))
+                return None
+            if op == "noop":        # leader-tenure marker (Raft §8)
+                return None
+            raise ValueError(f"unknown command {op!r}")
+
+    def state_snapshot(self) -> dict:
+        """Serialize the replicated state (tree + sessions + counters) —
+        the WAL snapshot payload and the differential-test fingerprint.
+        Local-only state (watches, queues, last_seen) is excluded."""
+        def ser(node: _Znode) -> dict:
+            return {"d": node.data.hex(), "o": node.ephemeral_owner,
+                    "s": node.seq,
+                    "c": {k: ser(v) for k, v in sorted(node.children.items())}}
+        with self._lock:
+            return {"next_sid": self._next_sid,
+                    "tree": ser(self._root),
+                    "sessions": {str(sid): sorted(s.ephemerals)
+                                 for sid, s in self._sessions.items()}}
+
+    def restore_state(self, state: dict) -> None:
+        """Replace all replicated state (boot recovery / snapshot
+        install). Restored sessions get a fresh liveness grace so
+        reconnecting clients keep their ephemerals."""
+        def de(obj: dict) -> _Znode:
+            n = _Znode(bytes.fromhex(obj["d"]), obj["o"])
+            n.seq = obj["s"]
+            n.children = {k: de(v) for k, v in obj["c"].items()}
+            return n
+        with self._lock:
+            self._root = de(state["tree"])
+            self._next_sid = state["next_sid"]
+            self._sessions = {}
+            for sid_s, eph in state["sessions"].items():
+                s = _Session(int(sid_s))
+                s.ephemerals = set(eph)
+                self._sessions[int(sid_s)] = s
+            self._watches.clear()
+
+    def touch_all_sessions(self) -> None:
+        """Reset every session's liveness clock — called when an
+        ensemble member becomes leader (or a restarted coordinator
+        boots) so sessions get a full timeout to re-reach the new
+        expiry clock before being declared dead."""
+        with self._lock:
+            now = time.monotonic()
+            for s in self._sessions.values():
+                s.last_seen = now
+
     # ---- sessions ----
 
     def new_session(self) -> int:
-        with self._lock:
-            sid = self._next_sid
-            self._next_sid += 1
-            self._sessions[sid] = _Session(sid)
-            return sid
+        return self._submit({"op": "new_session"})
 
     def heartbeat(self, sid: int) -> bool:
         """Refresh liveness; False if the session is gone (client must
-        treat this like an expired ZooKeeper session)."""
+        treat this like an expired ZooKeeper session). Not logged —
+        liveness lives on the expiry-clock owner, not in the state."""
         global_injector.check(f"coord.heartbeat.{sid}")
         with self._lock:
             s = self._sessions.get(sid)
@@ -143,13 +248,13 @@ class CoordinationCore:
             return True
 
     def close_session(self, sid: int) -> None:
-        with self._lock:
-            self._expire_locked(sid, reason="closed")
+        self._submit({"op": "close_session", "sid": sid,
+                      "reason": "closed"})
 
     def expire_session(self, sid: int) -> None:
         """Force-expire (fault injection: simulates a node partition)."""
-        with self._lock:
-            self._expire_locked(sid, reason="forced")
+        self._submit({"op": "expire_session", "sid": sid,
+                      "reason": "forced"})
 
     def _expire_locked(self, sid: int, reason: str) -> None:
         s = self._sessions.pop(sid, None)
@@ -173,12 +278,22 @@ class CoordinationCore:
     def _reap_loop(self) -> None:
         while not self._closed:
             time.sleep(min(0.1, self.session_timeout_s / 4))
+            if not self.expiry_enabled():
+                continue     # ensemble follower: leader owns the clock
             now = time.monotonic()
             with self._lock:
                 dead = [sid for sid, s in self._sessions.items()
                         if now - s.last_seen > self.session_timeout_s]
-                for sid in dead:
-                    self._expire_locked(sid, reason="timeout")
+            for sid in dead:
+                # expiry is a logged command: in ensemble mode it reaches
+                # every replica through the WAL (quorum first), exactly
+                # like ZooKeeper's leader-driven session expiry
+                try:
+                    self._submit({"op": "expire_session", "sid": sid,
+                                  "reason": "timeout"})
+                except Exception as e:
+                    log.warning("session expiry submit failed", sid=sid,
+                                err=repr(e))
 
     def close(self) -> None:
         self._closed = True
@@ -198,32 +313,35 @@ class CoordinationCore:
 
     def create(self, sid: int, path: str, data: bytes = b"",
                mode: str = PERSISTENT) -> str:
-        with self._lock:
-            parts = _split(path)
-            parent = self._resolve(parts[:-1])
-            name = parts[-1]
-            if mode == EPHEMERAL_SEQUENTIAL:
-                name = f"{name}{parent.seq:010d}"
-                parent.seq += 1
-            if name in parent.children:
-                raise NodeExistsError(path)
-            owner = sid if mode in (EPHEMERAL, EPHEMERAL_SEQUENTIAL) else None
-            parent.children[name] = _Znode(data, owner)
-            full = "/" + "/".join(parts[:-1] + [name])
-            if owner is not None:
-                s = self._sessions.get(sid)
-                if s is None:
-                    del parent.children[name]
-                    raise NoNodeError(f"session {sid} gone")
-                s.ephemerals.add(full)
-            parent_path = "/" + "/".join(parts[:-1]) if parts[:-1] else "/"
-            self._fire(full, "exists", NODE_CREATED)
-            self._fire(parent_path, "children", CHILDREN_CHANGED)
-            return full
+        return self._submit({"op": "create", "sid": sid, "path": path,
+                             "data": data.hex(), "mode": mode})
+
+    def _apply_create(self, sid: int, path: str, data: bytes,
+                      mode: str) -> str:
+        parts = _split(path)
+        parent = self._resolve(parts[:-1])
+        name = parts[-1]
+        if mode == EPHEMERAL_SEQUENTIAL:
+            name = f"{name}{parent.seq:010d}"
+            parent.seq += 1
+        if name in parent.children:
+            raise NodeExistsError(path)
+        owner = sid if mode in (EPHEMERAL, EPHEMERAL_SEQUENTIAL) else None
+        parent.children[name] = _Znode(data, owner)
+        full = "/" + "/".join(parts[:-1] + [name])
+        if owner is not None:
+            s = self._sessions.get(sid)
+            if s is None:
+                del parent.children[name]
+                raise NoNodeError(f"session {sid} gone")
+            s.ephemerals.add(full)
+        parent_path = "/" + "/".join(parts[:-1]) if parts[:-1] else "/"
+        self._fire(full, "exists", NODE_CREATED)
+        self._fire(parent_path, "children", CHILDREN_CHANGED)
+        return full
 
     def delete(self, sid: int, path: str) -> None:
-        with self._lock:
-            self._delete_locked(path)   # also clears the owner's ephemerals
+        self._submit({"op": "delete", "path": path})
 
     def _delete_locked(self, path: str) -> None:
         parts = _split(path)
@@ -255,8 +373,7 @@ class CoordinationCore:
             return self._resolve(_split(path)).data
 
     def set_data(self, sid: int, path: str, data: bytes) -> None:
-        with self._lock:
-            self._resolve(_split(path)).data = data
+        self._submit({"op": "set_data", "path": path, "data": data.hex()})
 
     def get_children(self, sid: int, path: str,
                      watch: bool = False) -> list[str]:
@@ -488,6 +605,7 @@ class LocalCoordination(_BaseCoordination):
 
 class _CoordHandler(BaseHTTPRequestHandler):
     core: CoordinationCore  # set by server factory
+    ensemble = None         # EnsembleNode when durable/replicated
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # route to structured logger
@@ -501,22 +619,47 @@ class _CoordHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _gate_leader(self) -> bool:
+        """Client-facing ops are served by the ensemble leader only
+        (linearizable reads + the leader-owned session/watch state);
+        followers answer 421 with the leader hint so the client's
+        multi-address failover can redirect."""
+        ens = self.ensemble
+        if ens is None or ens.is_leader():
+            return True
+        self._reply({"error": "not_leader", "leader": ens.leader_address()},
+                    421)
+        return False
+
     def do_GET(self) -> None:
         u = urlparse(self.path)
         if u.path == "/events":
+            if not self._gate_leader():
+                return
             q = parse_qs(u.query)
             sid = int(q["session"][0])
             timeout = float(q.get("timeout", ["25"])[0])
             evs = self.core.poll_events(sid, timeout)
             self._reply({"events": [[e.type, e.path] for e in evs]})
+        elif u.path == "/ensemble/status":
+            if self.ensemble is None:
+                self._reply({"error": "no ensemble"}, 404)
+            else:
+                self._reply(self.ensemble.status())
         else:
             self._reply({"error": "not found"}, 404)
 
     def do_POST(self) -> None:
         n = int(self.headers.get("Content-Length", "0"))
         req = json.loads(self.rfile.read(n) or b"{}")
+        u = urlparse(self.path)
+        if u.path.startswith("/ensemble/"):
+            self._ensemble_rpc(u.path, req)
+            return
         op = req.get("op")
         sid = req.get("session", 0)
+        if not self._gate_leader():
+            return
         try:
             if op == "new_session":
                 self._reply({"session": self.core.new_session(),
@@ -553,43 +696,151 @@ class _CoordHandler(BaseHTTPRequestHandler):
             self._reply({"error": "node_exists", "path": str(e)}, 409)
         except NoNodeError as e:
             self._reply({"error": "no_node", "path": str(e)}, 404)
+        except NotLeaderError as e:
+            self._reply({"error": "not_leader", "leader": e.leader}, 421)
+        except CoordinationUnavailable as e:
+            self._reply({"error": "unavailable", "detail": str(e)}, 503)
+
+    def _ensemble_rpc(self, path: str, req: dict) -> None:
+        ens = self.ensemble
+        if ens is None:
+            self._reply({"error": "no ensemble"}, 404)
+            return
+        if path == "/ensemble/vote":
+            self._reply(ens.handle_vote(req))
+        elif path == "/ensemble/append":
+            self._reply(ens.handle_append(req))
+        elif path == "/ensemble/snapshot":
+            self._reply(ens.handle_install_snapshot(req))
+        else:
+            self._reply({"error": "not found"}, 404)
 
 
 class CoordinationServer:
     """Serve a :class:`CoordinationCore` over HTTP (the ZooKeeper-server
-    role at ``zookeeper.connection``, ``application.properties:2``)."""
+    role at ``zookeeper.connection``, ``application.properties:2``).
+
+    Three durability modes:
+
+    - ``data_dir=None`` (default): in-memory standalone — the original
+      substrate; state dies with the process (tests, dev).
+    - ``data_dir`` set, no ``peers``: durable standalone — every write
+      goes through a fsynced WAL; a crashed-and-restarted coordinator
+      reconstructs the full znode tree + session table.
+    - ``data_dir`` + ``peers``: replicated ensemble member (Raft-style,
+      ``cluster/ensemble.py``) — a majority quorum commits every write
+      before it is acknowledged; the ensemble survives the loss of any
+      minority of members with zero lost acknowledged writes.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 session_timeout_s: float = 3.0) -> None:
+                 session_timeout_s: float = 3.0,
+                 data_dir: str | None = None, node_id: str = "",
+                 peers: dict[str, str] | None = None,
+                 election_timeout_s: float = 1.0,
+                 heartbeat_interval_s: float = 0.25,
+                 commit_timeout_s: float = 5.0,
+                 snapshot_every: int = 512,
+                 wal_fsync: bool = True) -> None:
+        if peers and not data_dir:
+            # never run a quorum whose hard state (term/voted_for/log)
+            # evaporates on restart — that can double-vote and lose
+            # acknowledged writes; refuse loudly instead of degrading
+            # to a silent single in-memory coordinator
+            raise ValueError("peers requires data_dir: ensemble hard "
+                             "state must be durable")
+        if peers and (node_id or "n0") not in peers:
+            # the map must include THIS member: a node replicating to
+            # its own address would depose itself on every election and
+            # the quorum size would count phantom members
+            raise ValueError(f"node_id {node_id or 'n0'!r} missing from "
+                             f"peers map {sorted(peers)}")
         self.core = CoordinationCore(session_timeout_s)
         handler = type("Handler", (_CoordHandler,), {"core": self.core})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.address = f"{host}:{self.httpd.server_address[1]}"
+        self.ensemble = None
+        if data_dir:
+            from tfidf_tpu.cluster.ensemble import EnsembleNode
+            nid = node_id or "n0"
+            all_peers = dict(peers or {})
+            my_address = all_peers.pop(nid, self.address)
+            self.ensemble = EnsembleNode(
+                core=self.core, data_dir=data_dir, node_id=nid,
+                peers=all_peers, my_address=my_address,
+                election_timeout_s=election_timeout_s,
+                heartbeat_interval_s=heartbeat_interval_s,
+                commit_timeout_s=commit_timeout_s,
+                snapshot_every=snapshot_every, wal_fsync=wal_fsync)
+            handler.ensemble = self.ensemble
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True, name="coord-server")
 
     def start(self) -> "CoordinationServer":
         self._thread.start()
-        log.info("coordination server up", address=self.address)
+        if self.ensemble is not None:
+            self.ensemble.start()
+        log.info("coordination server up", address=self.address,
+                 durable=self.ensemble is not None)
         return self
 
     def close(self) -> None:
+        if self.ensemble is not None:
+            self.ensemble.close()
         self.core.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def kill(self) -> None:
+        """Crash simulation: stop serving WITHOUT graceful session
+        expiry or any flush beyond what appends already fsynced —
+        recovery must come exclusively from the WAL + snapshot."""
+        if self.ensemble is not None:
+            self.ensemble.kill()
+        self.core._closed = True      # stop the reaper; no expiry events
         self.httpd.shutdown()
         self.httpd.server_close()
 
 
 class CoordinationClient(_BaseCoordination):
     """HTTP client session — the ``ZooKeeper`` client-bean analog
-    (``config/ZookeeperConfig.java:15-21``)."""
+    (``config/ZookeeperConfig.java:15-21``).
+
+    ``address`` may be a comma-separated member list (the ZooKeeper
+    connect-string shape, ``"c0:2181,c1:2181,c2:2181"``). Every RPC
+    fails over across members: connection failures rotate to the next
+    address; a follower's 421 ``not_leader`` reply redirects straight to
+    the leader hint. After a failover lands on a NEW server, all
+    outstanding one-shot watches are re-armed there and compared against
+    their last-read state — a change that happened during the failover
+    window is delivered as a synthesized event, so watch semantics
+    survive ensemble-leader loss (ZooKeeper's ``setWatches`` reconnect
+    dance)."""
 
     def __init__(self, address: str,
                  heartbeat_interval_s: float | None = None,
-                 timeout_s: float = 5.0) -> None:
+                 timeout_s: float = 5.0,
+                 failover_deadline_s: float = 10.0) -> None:
         super().__init__()
-        self.base = f"http://{address}"
+        self.addresses = [a.strip() for a in address.split(",") if a.strip()]
+        assert self.addresses, "at least one coordinator address required"
         self.timeout_s = timeout_s
+        # how long one logical op keeps rotating/redirecting before
+        # giving up — must comfortably span an ensemble leader election
+        self.failover_deadline_s = failover_deadline_s
+        self._addr_lock = threading.Lock()
+        self._addr_i = 0
+        self._last_good: str | None = None
+        # any connection-level failure since the last success: the next
+        # success re-arms watches even on the SAME address (a durable
+        # standalone coordinator restarts on its old host:port, and
+        # restore_state wiped its server-side watch table)
+        self._conn_failed = False
+        # (path, kind) -> last-read value for failover re-arm comparison
+        self._armed_state: dict[tuple[str, str], object] = {}
+        self._synthetic: deque[Event] = deque()
+        self._rearm_lock = threading.Lock()
         r = self._rpc({"op": "new_session"})
         self.sid = r["session"]
         interval = (heartbeat_interval_s if heartbeat_interval_s is not None
@@ -599,22 +850,155 @@ class CoordinationClient(_BaseCoordination):
         self._hb.start()
         self.start()
 
-    def _rpc(self, req: dict) -> dict:
+    # ---- address failover ----
+
+    def _current(self) -> str:
+        with self._addr_lock:
+            return self.addresses[self._addr_i % len(self.addresses)]
+
+    def _advance(self) -> None:
+        with self._addr_lock:
+            self._addr_i = (self._addr_i + 1) % len(self.addresses)
+        global_metrics.inc("coord_addr_rotations")
+
+    def _redirect(self, leader: str | None) -> None:
+        if not leader:
+            self._advance()
+            return
+        with self._addr_lock:
+            if leader not in self.addresses:
+                self.addresses.append(leader)
+            self._addr_i = self.addresses.index(leader)
+
+    def _note_success(self, base: str, rearm_ok: bool = True) -> None:
+        prev, self._last_good = self._last_good, base
+        failed, self._conn_failed = self._conn_failed, False
+        moved = prev is not None and prev != base
+        if moved:
+            global_metrics.inc("coord_failovers")
+            log.info("failed over", frm=prev, to=base)
+        if (moved or (failed and prev is not None)) and rearm_ok:
+            # new server OR possible same-address restart: either way
+            # the server-side watch table may no longer have our watches
+            self._rearm_watches()
+
+    # Mutations are NOT retried after an ambiguous failure (the request
+    # may have been delivered and committed — re-sending an
+    # EPHEMERAL_SEQUENTIAL create would mint a second znode and wedge
+    # the election on an orphan candidate). Only provably-undelivered
+    # failures (connection refused) and pre-execution rejections
+    # (421 not_leader) are safe to retry for these ops.
+    _MUTATING_OPS = frozenset(
+        {"create", "delete", "set_data", "close_session"})
+
+    @staticmethod
+    def _definitely_undelivered(e: Exception) -> bool:
+        if isinstance(e, ConnectionRefusedError):
+            return True
+        return (isinstance(e, urllib.error.URLError)
+                and isinstance(getattr(e, "reason", None),
+                               ConnectionRefusedError))
+
+    def _rpc(self, req: dict, _rearm: bool = True) -> dict:
         req.setdefault("session", getattr(self, "sid", 0))
         body = json.dumps(req).encode()
-        r = urllib.request.Request(self.base + "/rpc", data=body,
-                                   headers={"Content-Type":
-                                            "application/json"})
+        mutating = req.get("op") in self._MUTATING_OPS
+        deadline = time.monotonic() + self.failover_deadline_s
+        last_exc: Exception = CoordinationUnavailable("no address tried")
+        tries = 0
+        while tries == 0 or time.monotonic() < deadline:
+            tries += 1
+            base = self._current()
+            r = urllib.request.Request(f"http://{base}/rpc", data=body,
+                                       headers={"Content-Type":
+                                                "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        r, timeout=self.timeout_s) as resp:
+                    payload = json.loads(resp.read())
+                self._note_success(base, _rearm)
+                return payload
+            except urllib.error.HTTPError as e:
+                payload = json.loads(e.read() or b"{}")
+                err = payload.get("error")
+                if err == "node_exists":
+                    self._note_success(base, _rearm)
+                    raise NodeExistsError(payload.get("path", ""))
+                if err == "no_node":
+                    self._note_success(base, _rearm)
+                    raise NoNodeError(payload.get("path", ""))
+                if err == "not_leader":
+                    # rejected before execution: always safe to retry
+                    last_exc = e
+                    self._redirect(payload.get("leader"))
+                    # no hint = mid-election: wait for it to conclude
+                    time.sleep(0.02 if payload.get("leader") else 0.1)
+                    continue
+                if err == "unavailable" or e.code >= 500:
+                    if err == "unavailable" and mutating:
+                        # commit timeout: the entry may still commit
+                        # later — surface the ambiguity, don't re-send
+                        raise CoordinationUnavailable(
+                            payload.get("detail", "no quorum"))
+                    last_exc = e
+                    self._advance()
+                    time.sleep(0.05)
+                    continue
+                raise
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError) as e:
+                self._conn_failed = True
+                if mutating and not self._definitely_undelivered(e):
+                    raise
+                last_exc = e
+                self._advance()
+                time.sleep(0.05)
+                continue
+        raise last_exc
+
+    # ---- watch re-arm after failover ----
+
+    def _rearm_watches(self) -> None:
+        """Re-register every outstanding one-shot watch on the new
+        server; if the watched state changed while we were failing
+        over, deliver the missed transition as a synthesized event
+        (one-shot semantics preserved: changed -> fire once; unchanged
+        -> stays armed server-side)."""
+        if not self._rearm_lock.acquire(blocking=False):
+            return      # another thread is already re-arming
         try:
-            with urllib.request.urlopen(r, timeout=self.timeout_s) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            payload = json.loads(e.read() or b"{}")
-            if payload.get("error") == "node_exists":
-                raise NodeExistsError(payload.get("path", ""))
-            if payload.get("error") == "no_node":
-                raise NoNodeError(payload.get("path", ""))
-            raise
+            with self._wlock:
+                armed = dict(self._armed_state)
+            for (path, kind), last in armed.items():
+                try:
+                    if kind == "exists":
+                        cur: object = bool(self._rpc(
+                            {"op": "exists", "path": path, "watch": True},
+                            _rearm=False)["exists"])
+                        ev = (Event(NODE_CREATED if cur else NODE_DELETED,
+                                    path) if cur != last else None)
+                    else:
+                        cur = list(self._rpc(
+                            {"op": "get_children", "path": path,
+                             "watch": True}, _rearm=False)["children"])
+                        ev = (Event(CHILDREN_CHANGED, path)
+                              if cur != last else None)
+                    with self._wlock:
+                        if ev is not None:
+                            self._armed_state.pop((path, kind), None)
+                            self._synthetic.append(ev)
+                        else:
+                            self._armed_state[(path, kind)] = cur
+                except Exception as e:
+                    # leave the armed entry and re-flag the failure so
+                    # the next successful op retries the re-arm — a
+                    # one-shot giving up here would lose the watch
+                    self._conn_failed = True
+                    log.warning("watch re-arm failed", path=path,
+                                kind=kind, err=repr(e))
+            global_metrics.inc("coord_watch_rearms")
+        finally:
+            self._rearm_lock.release()
 
     def _hb_loop(self, interval: float) -> None:
         # same discipline as LocalCoordination: retry a failed heartbeat
@@ -640,11 +1024,53 @@ class CoordinationClient(_BaseCoordination):
 
     def _poll(self, timeout_s: float) -> list[Event]:
         global_injector.check("coord.long_poll")
-        url = (f"{self.base}/events?session={self.sid}"
-               f"&timeout={timeout_s}")
-        with urllib.request.urlopen(url, timeout=timeout_s + 5) as resp:
-            payload = json.loads(resp.read())
-        return [Event(t, p) for t, p in payload["events"]]
+        with self._wlock:
+            if self._synthetic:
+                evs = list(self._synthetic)
+                self._synthetic.clear()
+                return evs
+        deadline = time.monotonic() + self.failover_deadline_s
+        last_exc: Exception = CoordinationUnavailable("no address tried")
+        payload = None
+        tries = 0
+        while tries == 0 or time.monotonic() < deadline:
+            if self._closed.is_set():
+                raise CoordinationUnavailable("client closed")
+            tries += 1
+            base = self._current()
+            url = (f"http://{base}/events?session={self.sid}"
+                   f"&timeout={timeout_s}")
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=timeout_s + 5) as resp:
+                    payload = json.loads(resp.read())
+                self._note_success(base)
+                break
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read() or b"{}")
+                if body.get("error") == "not_leader":
+                    last_exc = e
+                    self._redirect(body.get("leader"))
+                    time.sleep(0.02 if body.get("leader") else 0.1)
+                    continue
+                last_exc = e
+                self._advance()
+                time.sleep(0.05)
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError) as e:
+                self._conn_failed = True
+                last_exc = e
+                self._advance()
+                time.sleep(0.05)
+        if payload is None:
+            raise last_exc
+        evs = [Event(t, p) for t, p in payload["events"]]
+        with self._wlock:
+            for ev in evs:      # a fired watch is no longer armed
+                kind = ("children" if ev.type == CHILDREN_CHANGED
+                        else "exists")
+                self._armed_state.pop((ev.path, kind), None)
+        return evs
 
     def create(self, path, data=b"", mode=PERSISTENT):
         return self._rpc({"op": "create", "path": path, "data": data.hex(),
@@ -655,8 +1081,12 @@ class CoordinationClient(_BaseCoordination):
 
     def exists(self, path, watcher: Watcher | None = None) -> bool:
         self._arm(path, "exists", watcher)
-        return self._rpc({"op": "exists", "path": path,
-                          "watch": watcher is not None})["exists"]
+        got = bool(self._rpc({"op": "exists", "path": path,
+                              "watch": watcher is not None})["exists"])
+        if watcher is not None:
+            with self._wlock:
+                self._armed_state[(path, "exists")] = got
+        return got
 
     def get_data(self, path) -> bytes:
         return bytes.fromhex(self._rpc({"op": "get_data",
@@ -667,12 +1097,19 @@ class CoordinationClient(_BaseCoordination):
 
     def get_children(self, path, watcher: Watcher | None = None) -> list[str]:
         self._arm(path, "children", watcher)
-        return self._rpc({"op": "get_children", "path": path,
+        kids = self._rpc({"op": "get_children", "path": path,
                           "watch": watcher is not None})["children"]
+        if watcher is not None:
+            with self._wlock:
+                self._armed_state[(path, "children")] = list(kids)
+        return kids
 
     def close(self) -> None:
         super().close()
         try:
+            # best-effort goodbye: don't spend the full failover budget
+            # on a coordinator that is already gone
+            self.failover_deadline_s = min(self.failover_deadline_s, 1.0)
             self._rpc({"op": "close_session"})
         except Exception:
             pass

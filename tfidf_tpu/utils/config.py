@@ -28,6 +28,9 @@ class Config:
     index_path: str = "./data/index"
 
     # --- node / control plane (reference: application.properties:2,8) ---
+    # May be a comma-separated ensemble connect string
+    # ("c0:2181,c1:2181,c2:2181") — clients fail over across members and
+    # follow follower->leader redirects (cluster/coordination.py).
     coordinator_address: str = "127.0.0.1:2181"
     host: str = "127.0.0.1"
     port: int = 8085
@@ -183,6 +186,31 @@ class Config:
     # from it. Scope: documents placed during the current leader's
     # tenure (a freshly promoted leader starts with an empty store).
     shard_recovery: bool = True
+
+    # --- coordination durability + quorum (cluster/wal.py, ensemble.py) ---
+    # Empty data dir = in-memory substrate (the pre-durability behavior).
+    # Set it and every coordinator write goes through a CRC-framed,
+    # fsynced WAL with periodic snapshots; a crashed coordinator
+    # restarted on the same dir recovers the full znode tree + sessions.
+    coord_data_dir: str = ""
+    # This member's id and the full member map ("id=host:port,..."
+    # including self). With peers set the coordinator is one member of a
+    # Raft-style ensemble: writes are acknowledged only after a majority
+    # has them durably, so a 3-member ensemble survives the loss of any
+    # one member with zero lost acknowledged writes.
+    coord_node_id: str = ""
+    coord_peers: str = ""
+    # fsync every WAL append before acknowledging (the Raft/ZooKeeper
+    # contract). Off trades the crash-tail window for throughput.
+    wal_fsync: bool = True
+    # Snapshot + compact the WAL every N applied commands.
+    wal_snapshot_every: int = 512
+    # Election timeout base (randomized 1x-2x per member) and the
+    # leader's heartbeat/replication interval; commit timeout bounds how
+    # long a write waits for quorum before failing WITHOUT an ack.
+    ensemble_election_timeout_s: float = 1.0
+    ensemble_heartbeat_s: float = 0.25
+    ensemble_commit_timeout_s: float = 5.0
 
     # --- resilience (cluster plane) ---
     # Leader->worker RPC retry policy: bounded attempts with exponential

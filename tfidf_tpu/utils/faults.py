@@ -44,6 +44,15 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
                                 "probe (observe-only)",
     "checkpoint.pre_publish": "checkpoint written but not yet published "
                               "(crash window)",
+    "wal.append": "coordination WAL about to frame+write an entry batch "
+                  "(failure = write not acknowledged)",
+    "wal.fsync": "coordination WAL about to fsync appended entries",
+    "wal.snapshot": "coordination snapshot about to be written "
+                    "(pre-atomic-rename crash window)",
+    "ensemble.vote": "ensemble member handling a RequestVote RPC",
+    "ensemble.replicate_append.*": "ensemble leader about to send "
+                                   "AppendEntries/InstallSnapshot to one "
+                                   "peer (suffix: peer node id)",
 }
 
 

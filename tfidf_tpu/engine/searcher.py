@@ -14,11 +14,13 @@ terms. Unknown query terms are dropped (they can match nothing).
 
 from __future__ import annotations
 
+import threading
 from typing import NamedTuple
 
 import numpy as np
 
 from tfidf_tpu.engine.index import ShardIndex, Snapshot
+from tfidf_tpu.engine.pipeline import PipelineExecutor
 from tfidf_tpu.engine.segments import SegmentedSnapshot
 from tfidf_tpu.engine.vocab import Vocabulary
 from tfidf_tpu.models.base import ScoringModel
@@ -27,7 +29,7 @@ from tfidf_tpu.ops.csr import next_capacity
 from tfidf_tpu.ops.ell import score_ell_batch, score_segments_batch
 from tfidf_tpu.ops.scoring import (QueryBatch, make_query_batch,
                                    score_coo_batch)
-from tfidf_tpu.ops.topk import (full_ranking, packed_topk,
+from tfidf_tpu.ops.topk import (fetch_packed, full_ranking, packed_topk,
                                 packed_topk_chunked, unpack_topk)
 from tfidf_tpu.utils.metrics import global_metrics
 from tfidf_tpu.utils.tracing import trace_phase
@@ -36,6 +38,11 @@ from tfidf_tpu.utils.tracing import trace_phase
 class SearchHit(NamedTuple):
     name: str
     score: float
+
+
+# guards lazy per-searcher PipelineExecutor construction (the mixin has
+# no __init__ of its own to hang a per-instance lock on)
+_pipe_init_lock = threading.Lock()
 
 
 def vectorize_queries(queries: list[str], analyzer: Analyzer,
@@ -84,6 +91,8 @@ class QueryVectorizerMixin:
     drift."""
 
     _u_floor = 256
+    _pipe: PipelineExecutor | None = None
+    pipeline_mode = "auto"
 
     def _vectorize(self, queries, cap):
         qb, widest = vectorize_queries(
@@ -93,30 +102,92 @@ class QueryVectorizerMixin:
         self._u_floor = max(self._u_floor, qb.uniq.shape[0])
         return qb, widest
 
-    def _run_pipelined(self, chunks, dispatch, finish) -> list:
-        """Run ``dispatch(chunk) -> state`` over chunks with up to
-        ``pipeline_depth`` OVERLAPPED fetches — later chunks' device
-        programs launch before earlier chunks' results are fetched,
-        hiding the device->host RTT under compute.
+    def _pipeline(self) -> PipelineExecutor:
+        """The searcher's SHARED dispatch/fetch executor (lazy). One per
+        searcher, shared by every concurrent search call: chunks from
+        concurrent ``/worker/process-batch`` handlers interleave on its
+        dispatch thread, so batch B's device program launches while
+        batch A's fetch is still on the wire — the overlap the old
+        per-call loop could not provide (PERF.md round 6)."""
+        pipe = self._pipe
+        if pipe is None:
+            with _pipe_init_lock:
+                pipe = self._pipe
+                if pipe is None:   # lost the race: reuse the winner's
+                    # (two first-searches double-constructing would
+                    # transiently double the depth+1 HBM budget and
+                    # leak a thread pair until idle exit)
+                    pipe = self._pipe = PipelineExecutor(
+                        depth=max(1, getattr(self, "pipeline_depth",
+                                             1)),
+                        name="search")
+        return pipe
+
+    def _use_executor(self) -> bool:
+        """Resolve ``pipeline_mode``: the executor buys overlap only
+        where the d2h fetch has real latency (TPU/GPU, tunneled links);
+        on the CPU backend a "fetch" is a shared-memory view, and the
+        three thread hand-offs per chunk cost more than they hide —
+        measured ~27% concurrent-caller throughput loss — so "auto"
+        keeps CPU inline and turns the executor on for accelerators."""
+        mode = getattr(self, "pipeline_mode", "auto")
+        if mode == "executor":
+            return True
+        if mode == "inline":
+            return False
+        import jax
+        return jax.default_backend() != "cpu"
+
+    def _run_pipelined(self, chunks, dispatch, fetch, assemble) -> list:
+        """Run chunks with up to ``pipeline_depth`` OVERLAPPED fetches:
+        ``dispatch(chunk) -> state`` launches device work,
+        ``fetch(*state) -> fetched`` performs the single d2h transfer,
+        ``assemble(*fetched) -> hits`` builds results on the caller's
+        thread. On accelerator backends (or ``pipeline_mode=
+        "executor"``) the stages run on the shared
+        :class:`PipelineExecutor`, so chunks from CONCURRENT search
+        calls also overlap; on CPU ("auto") the same stages run inline
+        dispatch-then-drain (the fetch is free there and the executor's
+        thread hand-offs are pure overhead).
 
         In-flight accounting (ADVICE r4, option B): dispatch-then-drain
         keeps **depth+1 chunks in flight** (depth fetches overlapping
-        the newest chunk's compute). The r5 drain-before-dispatch
-        variant (depth chunks total, depth-1 overlapped) measured ~2x
-        slower on RTT-bound configs, so the extra in-flight buffer is
-        kept deliberately — HBM sizing must budget depth+1 packed
-        buffers (see probe_msmarco's B cap)."""
+        the newest chunk's compute; enforced by the executor's bounded
+        hand-off queue). The r5 drain-before-dispatch variant (depth
+        chunks total, depth-1 overlapped) measured ~2x slower on
+        RTT-bound configs, so the extra in-flight buffer is kept
+        deliberately — HBM sizing must budget depth+1 packed buffers
+        (see probe_msmarco's B cap)."""
+        if not self._use_executor():
+            return self._run_inline(chunks, dispatch, fetch, assemble)
+        pipe = self._pipeline()
+        futures = [pipe.submit(lambda c=chunk: dispatch(c), fetch)
+                   for chunk in chunks]
+        out: list = []
+        try:
+            for fut in futures:
+                out.extend(assemble(*fut.result()))
+        except BaseException:
+            for fut in futures:   # don't run chunks nobody will read
+                fut.cancel()
+            raise
+        return out
+
+    def _run_inline(self, chunks, dispatch, fetch, assemble) -> list:
+        """Single-thread dispatch-then-drain over the SAME three stages
+        (the pre-executor loop): overlaps one call's chunks via async
+        dispatch, but not chunks across concurrent calls."""
         from collections import deque
 
-        depth = getattr(self, "pipeline_depth", 1)
+        depth = max(1, getattr(self, "pipeline_depth", 1))
         pending: deque = deque()
         out: list = []
         for chunk in chunks:
             pending.append(dispatch(chunk))
             if len(pending) > depth:
-                out.extend(finish(*pending.popleft()))
+                out.extend(assemble(*fetch(*pending.popleft())))
         while pending:
-            out.extend(finish(*pending.popleft()))
+            out.extend(assemble(*fetch(*pending.popleft())))
         return out
 
 
@@ -126,7 +197,8 @@ class Searcher(QueryVectorizerMixin):
                  *, query_batch: int = 32, max_query_terms: int = 32,
                  top_k: int = 10, result_order: str = "score",
                  use_pallas: bool = False,
-                 pipeline_depth: int = 2) -> None:
+                 pipeline_depth: int = 2,
+                 pipeline_mode: str = "auto") -> None:
         self.index = index
         self.analyzer = analyzer
         self.vocab = vocab
@@ -145,6 +217,8 @@ class Searcher(QueryVectorizerMixin):
         # dispatched — see _run_pipelined's in-flight accounting; each
         # pending chunk holds only a packed [B, 2k] top-k buffer)
         self.pipeline_depth = max(1, pipeline_depth)
+        # "auto" | "executor" | "inline" — see _use_executor
+        self.pipeline_mode = pipeline_mode
 
     def _batch_cap(self, n: int) -> int:
         return min(self.query_batch, next_capacity(max(n, 1), 1))
@@ -183,9 +257,45 @@ class Searcher(QueryVectorizerMixin):
              for lo in range(0, len(queries), cap)),
             lambda chunk: (chunk,) + self._dispatch_chunk(snap, chunk,
                                                           k),
-            lambda *state: self._finish_chunk(snap, *state)))
+            lambda chunk, packed, kk: (chunk, fetch_packed(packed), kk),
+            lambda chunk, arr, kk: self._finish_chunk(snap, chunk, arr,
+                                                      kk)))
         global_metrics.inc("queries_served", len(queries))
         return out
+
+    def search_arrays(self, queries: list[str], k: int | None = None):
+        """Pipelined exact top-k returning the RAW result arrays —
+        ``(vals [N, kk] f32, ids [N, kk] i32, kk, names)`` — instead of
+        assembled :class:`SearchHit` lists. ``ids`` index ``names``;
+        entries whose value is non-finite or <= 0 are dead (padding /
+        no match), exactly the rows :meth:`_assemble` would drop. The
+        worker serving path packs these straight into the scatter wire
+        reply (:func:`tfidf_tpu.cluster.wire.pack_topk_arrays`) without
+        building per-hit Python objects, keeping the post-fetch host
+        cost off the serving critical path."""
+        snap = self.index.snapshot
+        k = self.top_k if k is None else k
+        if snap is None or not snap.num_names or not queries:
+            n = len(queries)
+            return (np.zeros((n, 0), np.float32),
+                    np.zeros((n, 0), np.int32), 0, [])
+        kk = min(k, snap.num_names)
+        cap = self._batch_cap(len(queries))
+        parts = self._run_pipelined(
+            (queries[lo:lo + cap]
+             for lo in range(0, len(queries), cap)),
+            lambda chunk: (chunk,) + self._dispatch_chunk(snap, chunk,
+                                                          k),
+            lambda chunk, packed, kk_: (chunk, fetch_packed(packed),
+                                        kk_),
+            # assemble: two views of the fetched buffer, pad rows cut
+            lambda chunk, arr, kk_: [unpack_topk(arr[:len(chunk)])])
+        vals = np.concatenate([p[0] for p in parts], axis=0)
+        ids = np.concatenate([p[1] for p in parts], axis=0)
+        names = (snap.padded_names if isinstance(snap, SegmentedSnapshot)
+                 else snap.doc_names)
+        global_metrics.inc("queries_served", len(queries))
+        return vals, ids, kk, names
 
     def _score_chunk(self, snap: Snapshot, queries: list[str]):
         cap = self._batch_cap(len(queries))
@@ -224,8 +334,10 @@ class Searcher(QueryVectorizerMixin):
 
     def _finish_chunk(self, snap: Snapshot, queries: list[str],
                       packed, kk: int) -> list[list[SearchHit]]:
-        # ONE d2h transfer for values+ids (high-latency host<->device
-        # links make per-fetch cost dominate)
+        # ``packed`` already crossed device->host in the fetch stage
+        # (fetch_packed: ONE transfer for values+ids — high-latency
+        # host<->device links make per-fetch cost dominate); this runs
+        # on the caller's thread and only splits views + builds hits
         vals, ids = unpack_topk(packed)
         return self._assemble(snap, queries, vals, ids, kk)
 

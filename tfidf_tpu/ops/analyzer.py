@@ -13,8 +13,9 @@ library calls inside the worker:
 * Apache Tika ``AutoDetectParser`` — the reference's fallback for non-UTF-8
   bytes (``Worker.java:198-212``). Reproduced as magic-byte dispatch with
   minimal pure-Python extractors (PDF ``Tj/TJ`` operators including
-  CID/ToUnicode-encoded text, DOCX ``word/document.xml``, ODT
-  ``content.xml``, RTF group-tree walking, HTML tag stripping), charset
+  CID/ToUnicode-encoded text, DOCX ``word/document.xml``, PPTX slide
+  ``<a:t>`` runs, XLSX shared/inline strings, ODT ``content.xml``, RTF
+  group-tree walking, HTML tag stripping), charset
   fallback for plain text, and a typed :class:`UnsupportedMediaType`
   rejection for binaries — an upload is extracted or refused, never
   indexed as mojibake.
@@ -325,29 +326,68 @@ def _extract_pdf(data: bytes) -> str:
     return " ".join(texts)
 
 
-def _extract_docx(data: bytes) -> str:
-    """DOCX = zip + word/document.xml; text lives in ``<w:t>`` runs."""
+def _extract_docx(z) -> str:
+    """DOCX = zip + word/document.xml; text lives in ``<w:t>`` runs.
+    ``z`` is the container already opened by :func:`extract_text`'s
+    routing pass (one central-directory parse per document)."""
     import html
-    import io
-    import zipfile
 
-    with zipfile.ZipFile(io.BytesIO(data)) as z:
-        with z.open("word/document.xml") as f:
-            xml = f.read().decode("utf-8", "replace")
+    with z.open("word/document.xml") as f:
+        xml = f.read().decode("utf-8", "replace")
     parts = re.findall(r"<w:t[^>]*>(.*?)</w:t>", xml, re.S)
     return html.unescape(re.sub(r"<[^>]+>", " ", " ".join(parts)))
 
 
-def _extract_odt(data: bytes) -> str:
+def _extract_pptx(z) -> str:
+    """PPTX = zip + ``ppt/slides/slideN.xml`` (plus notes slides);
+    visible text lives in DrawingML ``<a:t>`` runs — the same plain
+    zip+XML walk as the DOCX path (Tika's OOXML parser analog)."""
+    import html
+
+    def order(name: str):
+        # numeric slide order (slide2 before slide10 — a lexicographic
+        # sort scrambles decks past 9 slides), slides before notes
+        m = re.search(r"(\d+)\.xml$", name)
+        return (name.startswith("ppt/notesSlides/"),
+                int(m.group(1)) if m else 0, name)
+
+    slides = sorted(
+        (n for n in z.namelist()
+         if re.fullmatch(r"ppt/(?:slides|notesSlides)/[^/]+\.xml", n)),
+        key=order)
+    parts: list[str] = []
+    for n in slides:
+        xml = z.read(n).decode("utf-8", "replace")
+        parts.extend(re.findall(r"<a:t[^>]*>(.*?)</a:t>", xml, re.S))
+    return html.unescape(re.sub(r"<[^>]+>", " ", " ".join(parts)))
+
+
+def _extract_xlsx(z) -> str:
+    """XLSX = zip + ``xl/sharedStrings.xml`` (the shared cell-string
+    table, ``<t>`` runs) plus per-sheet inline strings (``<is><t>``).
+    Numbers/formulas carry no searchable text and are skipped."""
+    import html
+
+    names = z.namelist()
+    parts: list[str] = []
+    if "xl/sharedStrings.xml" in names:
+        xml = z.read("xl/sharedStrings.xml").decode("utf-8", "replace")
+        parts.extend(re.findall(r"<t[^>]*>(.*?)</t>", xml, re.S))
+    for n in sorted(n for n in names
+                    if re.fullmatch(r"xl/worksheets/[^/]+\.xml", n)):
+        xml = z.read(n).decode("utf-8", "replace")
+        for blk in re.findall(r"<is>(.*?)</is>", xml, re.S):
+            parts.extend(re.findall(r"<t[^>]*>(.*?)</t>", blk, re.S))
+    return html.unescape(re.sub(r"<[^>]+>", " ", " ".join(parts)))
+
+
+def _extract_odt(z) -> str:
     """OpenDocument Text = zip + ``content.xml``; body text lives in
     ``<text:p>``/``<text:span>`` runs (Tika's ODF parser analog)."""
     import html
-    import io
-    import zipfile
 
-    with zipfile.ZipFile(io.BytesIO(data)) as z:
-        with z.open("content.xml") as f:
-            xml = f.read().decode("utf-8", "replace")
+    with z.open("content.xml") as f:
+        xml = f.read().decode("utf-8", "replace")
     body = re.search(r"<office:body>(.*)</office:body>", xml, re.S)
     xml = body.group(1) if body is not None else xml
     # paragraph/tab/space elements carry whitespace semantics
@@ -630,7 +670,8 @@ def extract_text(data: bytes) -> str:
     """Bytes -> searchable text, the Tika-parity dispatch.
 
     Known document formats are extracted (PDF including CID/ToUnicode
-    text, DOCX, ODT, RTF, HTML); plain text goes through charset
+    text, DOCX, PPTX, XLSX, ODT, RTF, HTML); plain text goes through
+    charset
     fallback (UTF-8 strict first, like ``Files.readString``, then BOM'd
     UTF-16, then Latin-1); recognized binaries, undecodable blobs, and
     text-free documents raise :class:`UnsupportedMediaType` instead of
@@ -661,16 +702,39 @@ def extract_text(data: bytes) -> str:
             raise UnsupportedMediaType(".doc with no extractable text")
         return text
     if data[:4] == b"PK\x03\x04":
-        text = None
+        import io
+        import zipfile
+
         try:
-            text = _extract_docx(data)
+            zf = zipfile.ZipFile(io.BytesIO(data))
         except Exception:
-            try:
-                text = _extract_odt(data)
-            except Exception:
+            raise UnsupportedMediaType("unreadable zip container")
+        # route by the container's member layout (what Tika's container
+        # detector does) instead of try/except chaining extractors; the
+        # ONE opened ZipFile (one central-directory parse) is handed to
+        # the extractor
+        with zf as z:
+            names = set(z.namelist())
+            if "word/document.xml" in names:
+                extractor = _extract_docx
+            elif any(n.startswith("ppt/slides/") for n in names):
+                extractor = _extract_pptx
+            elif "xl/workbook.xml" in names:
+                extractor = _extract_xlsx
+            elif "content.xml" in names:
+                extractor = _extract_odt
+            else:
                 raise UnsupportedMediaType(
-                    "zip container without word/document.xml or "
-                    "ODF content.xml")
+                    "zip container without a known document body "
+                    "(word/document.xml, ppt/slides/, xl/workbook.xml, "
+                    "or ODF content.xml)")
+            try:
+                text = extractor(z)
+            except UnsupportedMediaType:
+                raise
+            except Exception as e:
+                raise UnsupportedMediaType(
+                    f"unreadable document container ({type(e).__name__})")
         if not text.strip():
             raise UnsupportedMediaType(
                 "document container with no extractable text")

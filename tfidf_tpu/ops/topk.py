@@ -83,8 +83,23 @@ def packed_topk(scores: jax.Array, num_docs: jax.Array,
     return pack_topk(vals, idx)
 
 
+def fetch_packed(packed):
+    """The serving pipeline's FETCH stage: one device->host transfer of
+    the packed ``[..., 2k]`` top-k buffer, nothing else. Kept as a named
+    function so the single d2h per chunk lives in exactly one place —
+    the pipeline executor's fetch thread must do only this (hit
+    assembly/unpacking happens later, on the caller's thread, so it
+    never blocks the fetch stream)."""
+    import numpy as np
+
+    return np.asarray(packed)
+
+
 def unpack_topk(packed) -> tuple:
-    """Host-side inverse of :func:`pack_topk` (one np.asarray fetch)."""
+    """Host-side inverse of :func:`pack_topk`. Accepts either a device
+    array (fetches it — one np.asarray transfer) or the already-fetched
+    numpy buffer from :func:`fetch_packed` (pure views, no copy of the
+    ids lane)."""
     import numpy as np
 
     arr = np.asarray(packed)

@@ -376,7 +376,8 @@ class MeshSearcher(QueryVectorizerMixin):
                  *, query_batch: int = 32, max_query_terms: int = 32,
                  top_k: int = 10, result_order: str = "score",
                  global_idf: bool = True,
-                 pipeline_depth: int = 2) -> None:
+                 pipeline_depth: int = 2,
+                 pipeline_mode: str = "auto") -> None:
         self.index = index
         self.analyzer = analyzer
         self.vocab = vocab
@@ -386,6 +387,8 @@ class MeshSearcher(QueryVectorizerMixin):
         self.top_k = top_k
         self.result_order = result_order
         self.pipeline_depth = max(1, pipeline_depth)
+        # "auto" | "executor" | "inline" — see QueryVectorizerMixin
+        self.pipeline_mode = pipeline_mode
         # global_idf=False reproduces the reference's per-worker statistics
         # (each Lucene shard scores against local df/N, Worker.java:222-241)
         self.global_idf = global_idf
@@ -440,11 +443,15 @@ class MeshSearcher(QueryVectorizerMixin):
                                           self._batch_cap(len(chunk)))
             return (chunk,) + self._dispatch_chunk(snap, qb, k)
 
+        from tfidf_tpu.ops.topk import fetch_packed
+
         out = self._run_pipelined(
             (queries[lo:lo + cap]
              for lo in range(0, len(queries), cap)),
             dispatch,
-            lambda *state: self._finish_chunk(snap, *state))
+            lambda chunk, packed, kk: (chunk, fetch_packed(packed), kk),
+            lambda chunk, arr, kk: self._finish_chunk(snap, chunk, arr,
+                                                      kk))
         global_metrics.inc("queries_served", len(queries))
         return out
 
@@ -458,6 +465,8 @@ class MeshSearcher(QueryVectorizerMixin):
         return self._get_search_fn(kk)(snap.arrays, qb), kk
 
     def _finish_chunk(self, snap, chunk, packed, kk: int):
+        # packed already crossed device->host in the fetch stage; this
+        # runs on the caller's thread (views + hit assembly only)
         from tfidf_tpu.ops.topk import unpack_topk
         vals, gids = unpack_topk(packed)
         return self._assemble_hits(snap, chunk, vals, gids, kk)

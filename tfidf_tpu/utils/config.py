@@ -59,6 +59,15 @@ class Config:
     # query pays while waiting for company.
     micro_batch: bool = True
     batch_linger_ms: float = 2.0
+    # Adaptive linger bounds (serving pipeline, PERF.md round 6): with
+    # no batch in flight the coalescer lingers only *_linger_min_ms
+    # (the device is idle — dispatch at once); as the dispatcher
+    # pipeline saturates the linger stretches toward *_linger_max_ms
+    # (the wait hides under in-flight work and buys batch fill). Set
+    # either bound negative to disable adaptation and keep the fixed
+    # *_linger_ms. Env overrides: TFIDF_BATCH_LINGER_MIN_MS etc.
+    batch_linger_min_ms: float = 0.2
+    batch_linger_max_ms: float = 4.0
     # Concurrent in-flight micro-batches (scorer threads). 2 hides one
     # batch's device->host result fetch under the next batch's compute —
     # material on high-RTT device links (remote-TPU tunnels).
@@ -80,6 +89,11 @@ class Config:
     scatter_micro_batch: bool = True
     scatter_batch: int = 128
     scatter_linger_ms: float = 2.0
+    # Adaptive scatter linger (same rule as batch_linger_min/max_ms):
+    # idle pipeline -> linger_min (ship the group now), saturated
+    # pipeline -> linger_max (fuller groups; the wait is hidden).
+    scatter_linger_min_ms: float = 0.2
+    scatter_linger_max_ms: float = 8.0
     # Concurrent scatter dispatcher threads: one batch's worker RPC
     # round trip overlaps the next batch's formation.
     scatter_pipeline: int = 2
@@ -122,6 +136,14 @@ class Config:
     # fetch RTT; depth 2 overlaps one fetch with the next chunk's
     # compute (measured best — deeper only queues serial fetches).
     search_pipeline_depth: int = 2
+    # How the three pipeline stages (dispatch / d2h fetch / assemble)
+    # execute: "executor" = the shared two-thread PipelineExecutor
+    # (chunks from CONCURRENT search calls overlap — the serving-path
+    # win on high-RTT device links); "inline" = dispatch-then-drain on
+    # the calling thread (per-call overlap only); "auto" = executor on
+    # accelerator backends, inline on CPU (where fetches are free and
+    # the thread hand-offs are pure overhead).
+    search_pipeline_mode: str = "auto"
 
     # --- capacity bucketing (static shapes for XLA) ---
     min_doc_capacity: int = 1024

@@ -21,12 +21,40 @@
 #                     device programs + the pipeline executor on a fake
 #                     workload; writes PROBE_OVERLAP.json
 
+#   make graftcheck   project-native static analysis (tools/graftcheck):
+#                     lock-graph/deadlock, jit-purity, registry drift,
+#                     resilience coverage — against the committed
+#                     allowlist/baseline; new findings fail
+#   make lockdep      the chaos/resilience/cluster suites under the
+#                     runtime lockdep witness (instrumented Lock):
+#                     fails on any inversion or any ordering the
+#                     static lock graph cannot explain
+#   make check        graftcheck + tier-1 in one shot
+
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test chaos chaos-coord faults bench probe-overlap
+.PHONY: test chaos chaos-coord faults bench probe-overlap graftcheck \
+        lockdep check
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
+
+graftcheck:
+	python -m tools.graftcheck
+
+# Suite choice: resilience + cluster + graftcheck cover every
+# multi-lock ordering in the tree (the graftcheck suite drives a
+# durable ensemble coordinator too) and are timing-stable under the
+# instrumented Lock's overhead. test_coordination_durability's
+# randomized-election Raft tests are NOT run instrumented — their 1s
+# election margins flake under the added per-acquisition cost on
+# 2-core CI runners; they still run uninstrumented in tier-1.
+lockdep:
+	JAX_PLATFORMS=cpu GRAFTCHECK_LOCKDEP=1 python -m pytest \
+	  tests/test_resilience.py tests/test_cluster.py \
+	  tests/test_graftcheck.py $(PYTEST_FLAGS) -m 'not slow'
+
+check: graftcheck test
 
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py $(PYTEST_FLAGS) -m slow

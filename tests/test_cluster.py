@@ -344,8 +344,11 @@ class TestClusterEndToEnd:
         (token=None) settling last cleans up the unconfirmed claim
         (code-review r4)."""
         leader = cluster[0]
+        # registry read BEFORE taking the placement lock: production
+        # never nests these, and the lockdep witness holds tests to the
+        # same ordering discipline as the code under test
+        w = leader.registry.get_all_service_addresses()[0]
         with leader._placement_lock:
-            w = leader.registry.get_all_service_addresses()[0]
             tok = object()
             leader._placement["ghost.txt"] = w
             leader._claims["ghost.txt"] = tok

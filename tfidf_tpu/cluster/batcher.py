@@ -92,9 +92,34 @@ class Coalescer:
         with self._lock:
             if self._stopping:
                 raise RuntimeError(f"{self.name} stopped")
+            if not any(t.is_alive() for t in self._threads):
+                # every dispatcher died without stop() (a BaseException
+                # escaped _run): fail fast BEFORE enqueueing — under
+                # steady load, abandoned waiters would otherwise grow
+                # _items without bound
+                raise RuntimeError(f"{self.name} dispatchers died")
             self._items.append(w)
         self._wake.set()
-        w.event.wait()
+        # bounded-slice wait + shutdown check (graftcheck lockgraph
+        # indefinite-wait audit): a dispatcher that died mid-batch must
+        # not wedge this caller's thread forever. After stop(), queued
+        # waiters are failed by stop() itself; an in-flight batch gets a
+        # short grace to settle, then this caller fails loudly — and
+        # removes its still-queued waiter so the deque cannot leak.
+        while not w.event.wait(timeout=0.5):
+            if self._stopping or not any(
+                    t.is_alive() for t in self._threads):
+                if not w.event.wait(timeout=2.0):
+                    with self._lock:
+                        try:
+                            self._items.remove(w)
+                        except ValueError:
+                            pass   # already popped into a batch
+                    raise RuntimeError(
+                        f"{self.name} "
+                        + ("stopped" if self._stopping
+                           else "dispatchers died"))
+                break
         if w.error is not None:
             raise w.error
         return w.result
@@ -135,7 +160,13 @@ class Coalescer:
 
     def _run(self) -> None:
         while True:
-            self._wake.wait()
+            # bounded slice + shutdown re-check: a missed wake (or a
+            # peer that never wakes us again) must not park this
+            # dispatcher forever — the indefinite-wait audit's contract
+            if not self._wake.wait(timeout=0.5):
+                if self._stopping:
+                    return
+                continue
             if self._stopping:
                 return
             linger = self._effective_linger_s()
@@ -167,38 +198,55 @@ class Coalescer:
                     self._wake.clear()
             if not batch:
                 continue
-            t0 = time.perf_counter()
-            for w in batch:   # queueing delay, attributed separately
-                global_metrics.observe(f"{self.name}_linger", t0 - w.t0)
-            # gauge the wait that actually happened: at saturation the
-            # sleep is skipped, and reporting the computed linger there
-            # would misattribute latency exactly where none was added
-            global_metrics.set_gauge(f"last_{self.name}_linger_ms",
-                                     round(waited * 1e3, 3))
-            with self._lock:
-                self._dispatching += 1
             try:
-                results = self.batch_fn([w.query for w in batch])
-                for w, r in zip(batch, results):
-                    w.result = r
-            except Exception as e:
-                # honest propagation: every coalesced caller sees the
-                # SAME failure (never a fabricated empty success), and
-                # the counter sizes the blast radius of one bad batch
-                global_metrics.inc(f"{self.name}_batch_failures")
+                self._dispatch_batch(batch, waited)
+            except BaseException as e:
+                # anything that escapes _dispatch_batch (BaseException
+                # from batch_fn, a failure outside its Exception guard)
+                # is about to kill THIS dispatcher thread — popped
+                # waiters must never outlive it unsignaled, or their
+                # submit() calls wedge until stop()
                 for w in batch:
-                    w.error = e
-            finally:
-                with self._lock:
-                    self._dispatching -= 1
+                    if not w.event.is_set():
+                        w.error = RuntimeError(
+                            f"{self.name} dispatcher died: {e!r}")
+                        w.event.set()
+                raise
+
+    def _dispatch_batch(self, batch: list[_Waiter],
+                        waited: float) -> None:
+        t0 = time.perf_counter()
+        for w in batch:   # queueing delay, attributed separately
+            global_metrics.observe(f"{self.name}_linger", t0 - w.t0)
+        # gauge the wait that actually happened: at saturation the
+        # sleep is skipped, and reporting the computed linger there
+        # would misattribute latency exactly where none was added
+        global_metrics.set_gauge(f"last_{self.name}_linger_ms",
+                                 round(waited * 1e3, 3))
+        with self._lock:
+            self._dispatching += 1
+        try:
+            results = self.batch_fn([w.query for w in batch])
+            for w, r in zip(batch, results):
+                w.result = r
+        except Exception as e:
+            # honest propagation: every coalesced caller sees the
+            # SAME failure (never a fabricated empty success), and
+            # the counter sizes the blast radius of one bad batch
+            global_metrics.inc(f"{self.name}_batch_failures")
             for w in batch:
-                w.event.set()
-            global_metrics.observe(f"{self.name}_batch_total",
-                                   time.perf_counter() - t0)
-            global_metrics.inc(f"{self.name}_batches")
-            global_metrics.inc(f"{self.name}_items", len(batch))
-            global_metrics.set_gauge(f"last_{self.name}_batch_size",
-                                     len(batch))
+                w.error = e
+        finally:
+            with self._lock:
+                self._dispatching -= 1
+        for w in batch:
+            w.event.set()
+        global_metrics.observe(f"{self.name}_batch_total",
+                               time.perf_counter() - t0)
+        global_metrics.inc(f"{self.name}_batches")
+        global_metrics.inc(f"{self.name}_items", len(batch))
+        global_metrics.set_gauge(f"last_{self.name}_batch_size",
+                                 len(batch))
 
 
 class QueryBatcher(Coalescer):

@@ -15,7 +15,8 @@ import threading
 from typing import Protocol
 
 from tfidf_tpu.cluster.coordination import (NODE_DELETED, EPHEMERAL_SEQUENTIAL,
-                                            Event, NoNodeError)
+                                            CoordinationClient, Event,
+                                            LocalCoordination, NoNodeError)
 from tfidf_tpu.utils.logging import get_logger
 
 log = get_logger("cluster.election")
@@ -33,7 +34,8 @@ class OnElectionCallback(Protocol):
 
 
 class LeaderElection:
-    def __init__(self, coord, callback: OnElectionCallback) -> None:
+    def __init__(self, coord: "LocalCoordination | CoordinationClient",
+                 callback: OnElectionCallback) -> None:
         self.coord = coord
         self.callback = callback
         self.znode: str | None = None       # full path of my candidate node

@@ -841,16 +841,33 @@ class SearchNode:
             return None
 
     def _on_membership_change(self, old, new) -> None:
-        """Registry watch hook (watch-dispatch thread — hand off fast)."""
-        if (self._stopping or not self.config.shard_recovery
-                or not self.is_leader()):
+        """Registry watch hook (watch-dispatch thread — hand off fast).
+
+        The leader check happens in the SPAWNED thread, not here: it is
+        a coordination read (an RPC on the HTTP transport, up to the
+        client's failover deadline), and this hook runs under the
+        registry's notify lock on the shared watch-dispatch thread — a
+        stalled leader check here would delay every other client
+        event, including the election NodeDeleted that failover
+        latency depends on (graftcheck lockgraph finding)."""
+        if self._stopping or not self.config.shard_recovery:
             return
         lost = set(old) - set(new)
         joined = set(new) - set(old)
         if lost or joined:
             threading.Thread(
-                target=self._reconcile_membership, args=(lost, joined),
+                target=self._reconcile_if_leader, args=(lost, joined),
                 daemon=True, name=f"shard-recovery-{self.port}").start()
+
+    def _reconcile_if_leader(self, lost: set[str],
+                             joined: set[str]) -> None:
+        """Off-dispatch-thread half of the membership hook: the same
+        leader gate the hook used to apply inline (is_leader is
+        recomputed from live children either way, so the check was
+        always racy-by-design against a concurrent re-election)."""
+        if self._stopping or not self.is_leader():
+            return
+        self._reconcile_membership(lost, joined)
 
     def _reconcile_membership(self, lost: set[str],
                               joined: set[str]) -> None:

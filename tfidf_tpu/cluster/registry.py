@@ -17,8 +17,9 @@ from __future__ import annotations
 import threading
 
 from tfidf_tpu.cluster.coordination import (EPHEMERAL, EPHEMERAL_SEQUENTIAL,
-                                            Event, NodeExistsError,
-                                            NoNodeError)
+                                            CoordinationClient, Event,
+                                            LocalCoordination,
+                                            NodeExistsError, NoNodeError)
 from tfidf_tpu.utils.logging import get_logger
 
 log = get_logger("cluster.registry")
@@ -29,7 +30,8 @@ LEADER_INFO = "/leader_info"
 
 
 class ServiceRegistry:
-    def __init__(self, coord, on_change=None) -> None:
+    def __init__(self, coord: "LocalCoordination | CoordinationClient",
+                 on_change=None) -> None:
         """``on_change(old_addrs, new_addrs)`` fires after every
         membership-cache refresh that changed the set — the leader's
         shard-recovery hook (framework addition; the reference's cache
@@ -40,6 +42,22 @@ class ServiceRegistry:
         self._addresses: tuple[str, ...] | None = None
         self._on_membership = on_change
         self._lock = threading.Lock()
+        # refresh ordering WITHOUT holding _lock across coordination
+        # RPCs (graftcheck lockgraph finding): a refresh takes a ticket
+        # under the lock, reads the registry unlocked, and installs
+        # only if no later-ticketed refresh already did — the scatter
+        # hot path (get_all_service_addresses on every search) can
+        # never block behind a refresh riding the coordination
+        # client's failover deadline. Start-order tickets are an
+        # approximation of read order: a later-STARTED refresh whose
+        # read raced ahead of an earlier one's can briefly install a
+        # pre-change view — but every membership change also fires the
+        # armed one-shot watch, whose refresh starts after the change
+        # and outranks both, so the cache converges within one watch
+        # round-trip (the old whole-method lock bought total ordering
+        # at the cost of RPCs under the read-path lock)
+        self._refresh_ticket = 0
+        self._installed_ticket = 0
         # serializes hook delivery and anchors each notification's "old"
         # to the previously NOTIFIED state — two concurrent refreshes
         # must not deliver transitions out of order (a stale A->B after
@@ -82,22 +100,30 @@ class ServiceRegistry:
         return list(cached)
 
     # ``updateAddresses`` (:91-111): re-read children + data, swap cache,
-    # re-arm the one-shot watch by passing the watcher again.
+    # re-arm the one-shot watch by passing the watcher again. The
+    # coordination reads run OUTSIDE ``_lock`` — only the ticket draw
+    # and the install are locked (see __init__).
     def _update_addresses(self) -> None:
         with self._lock:
-            names = self.coord.get_children(REGISTRY_NAMESPACE,
-                                            watcher=self._on_change)
-            addrs = []
-            for name in names:
-                try:
-                    data = self.coord.get_data(
-                        f"{REGISTRY_NAMESPACE}/{name}")
-                except NoNodeError:
-                    continue   # vanished between listing and read (:99-103)
-                addrs.append(data.decode())
+            self._refresh_ticket += 1
+            ticket = self._refresh_ticket
+        names = self.coord.get_children(REGISTRY_NAMESPACE,
+                                        watcher=self._on_change)
+        addrs = []
+        for name in names:
+            try:
+                data = self.coord.get_data(
+                    f"{REGISTRY_NAMESPACE}/{name}")
+            except NoNodeError:
+                continue   # vanished between listing and read (:99-103)
+            addrs.append(data.decode())
+        with self._lock:
+            if ticket < self._installed_ticket:
+                return   # a later-ticketed refresh already installed
+            self._installed_ticket = ticket
             first = self._addresses is None
             self._addresses = tuple(addrs)
-            log.info("cluster addresses updated", addresses=addrs)
+        log.info("cluster addresses updated", addresses=addrs)
         if self._on_membership is None:
             return
         with self._notify_lock:

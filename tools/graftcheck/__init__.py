@@ -1,0 +1,39 @@
+"""graftcheck — project-native static analysis for the tfidf_tpu tree.
+
+Four analyzers, each an AST pass over the package (no imports of the
+code under analysis, so the suite runs without jax):
+
+- ``lockgraph``   — cross-module lock-acquisition-order graph: fails on
+  cycles (potential deadlock), on blocking calls (RPC, fsync, sleep,
+  indefinite waits, ``future.result()``) inside a held-lock region, and
+  on indefinite waits anywhere (``Event.wait()`` / ``Condition.wait()``
+  / ``Future.result()`` with no timeout).
+- ``jitpurity``   — any function reachable from a ``jax.jit`` /
+  ``shard_map`` entry point must not touch locks, metrics, fault
+  points, wall-clock, or mutable module globals (tracer-leak and
+  retrace hazards).
+- ``registry_drift`` — ``fault_point``/``global_injector.check`` call
+  sites vs ``KNOWN_FAULT_POINTS`` (both directions), ``Config`` fields
+  vs the README, metric reads vs metric emissions.
+- ``resilience``  — every leader→worker RPC in ``cluster/`` must flow
+  through ``ClusterResilience.worker_call``; a raw ``urlopen``/
+  ``http_post`` outside the wrapper is a finding.
+
+Intentional findings are pinned in two committed data files next to
+this package: ``allowlist.json`` (reviewed-intentional, with a reason
+per entry — never reported) and ``baseline.json`` (legacy findings
+tolerated until fixed — reported as baselined). Any finding in neither
+file fails the run. Keys are stable (no line numbers) so routine edits
+don't churn the pins.
+
+Run as ``python -m tools.graftcheck`` (see ``__main__``) or through
+``tests/test_graftcheck.py``. The runtime half — the lockdep witness
+that validates the static lock graph against actually-observed
+acquisition orders — lives in :mod:`tools.graftcheck.witness`.
+"""
+
+from tools.graftcheck.core import (Finding, SourceTree, load_allowlist,
+                                   load_baseline, run_analyzers)
+
+__all__ = ["Finding", "SourceTree", "load_allowlist", "load_baseline",
+           "run_analyzers"]

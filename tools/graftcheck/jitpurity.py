@@ -1,0 +1,231 @@
+"""JIT-purity analysis: tracer-leak / retrace hazards.
+
+Any function reachable from a ``jax.jit`` / ``pjit`` / ``shard_map``
+entry point runs under a tracer: side effects execute once at trace
+time and then silently never again (or worse, force retraces). This
+pass finds the entry points statically — ``@jax.jit`` decorators,
+``@functools.partial(jax.jit, ...)``, ``name = jax.jit(fn)``
+assignments, and ``shard_map(fn, ...)`` calls (including the
+``_compat`` alias) — walks the call graph beneath them, and flags:
+
+- lock operations (``with <lock>:``, ``.acquire()``);
+- metrics (``global_metrics`` / any resolvable ``Metrics`` method);
+- fault points (``fault_point`` / ``global_injector.check``);
+- wall-clock (``time.time``/``perf_counter``/``monotonic``/``sleep``);
+- mutable module globals (``global`` statements, stores to
+  module-level names or into module-level containers).
+
+``numpy``/``jax`` calls are fine; unresolvable calls are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import (Finding, FuncInfo, ModuleInfo,
+                                   SourceTree, _dotted)
+
+_WALL_CLOCK = {"time", "perf_counter", "monotonic", "sleep",
+               "process_time", "thread_time"}
+_JIT_NAMES = {"jit", "pjit"}
+_SHARD_MAP_NAMES = {"shard_map", "_shard_map"}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """True for `jax.jit`, `jit`, `pjit`, `functools.partial(jax.jit,…)`."""
+    dotted = _dotted(node)
+    if dotted is not None and dotted.split(".")[-1] in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):       # partial(jax.jit, ...)
+        d = _dotted(node.func)
+        if d is not None and d.split(".")[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class _Purity:
+    def __init__(self, tree: SourceTree) -> None:
+        self.tree = tree
+        self.findings: list[Finding] = []
+        self._seen: set[str] = set()
+        # reuse lockgraph's resolution machinery
+        from tools.graftcheck.lockgraph import LockGraph
+        self._lg = LockGraph.__new__(LockGraph)
+        self._lg.tree = tree
+        self._lg.edges = []
+        self._lg.findings = []
+        self._lg._summaries = {}
+        self._lg._in_progress = set()
+
+    # ---- entry-point discovery ----
+
+    def roots(self) -> list[tuple[ModuleInfo, FuncInfo, str]]:
+        out: list[tuple[ModuleInfo, FuncInfo, str]] = []
+        for mi in self.tree.modules.values():
+            by_name = self._funcs_by_name(mi)
+            for node in ast.walk(mi.tree):
+                # decorators
+                if isinstance(node, ast.FunctionDef):
+                    for dec in node.decorator_list:
+                        if _is_jit_expr(dec):
+                            fi = by_name.get(node.name)
+                            if fi is not None and fi.node is node:
+                                out.append((mi, fi, f"@jit {fi.qual}"))
+                # jax.jit(f) / shard_map(f, ...) call forms
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    leaf = d.split(".")[-1] if d else ""
+                    is_jit = _is_jit_expr(node.func)
+                    is_smap = leaf in _SHARD_MAP_NAMES
+                    if (is_jit or is_smap) and node.args:
+                        arg = node.args[0]
+                        kind = "shard_map" if is_smap else "jit"
+                        if isinstance(arg, ast.Name):
+                            fi = by_name.get(arg.id)
+                            if fi is not None:
+                                out.append((mi, fi,
+                                            f"{kind}({fi.qual})"))
+                        elif isinstance(arg, ast.Lambda):
+                            # jax.jit(lambda …) roots (mesh_ell_index's
+                            # _df_update): wrap the lambda as a
+                            # synthetic function so the same purity
+                            # walk applies — silently skipping it would
+                            # read as "covered" when it is not
+                            fi = FuncInfo(
+                                f"{mi.name}.<lambda@L{arg.lineno}>",
+                                mi.name, None, arg)
+                            out.append((mi, fi,
+                                        f"{kind}({fi.qual})"))
+        return out
+
+    def _funcs_by_name(self, mi: ModuleInfo) -> dict[str, FuncInfo]:
+        """Every function in the module, nested included, by bare name
+        (last definition wins — matches runtime rebinding)."""
+        out: dict[str, FuncInfo] = {}
+
+        def rec(fi: FuncInfo) -> None:
+            out[fi.node.name] = fi
+            for c in fi.nested.values():
+                rec(c)
+        for fi in mi.functions.values():
+            rec(fi)
+        for ci in mi.classes.values():
+            for fi in ci.methods.values():
+                for c in fi.nested.values():
+                    rec(c)
+        return out
+
+    # ---- reachability + purity check ----
+
+    def check(self) -> list[Finding]:
+        for mi, fi, root in self.roots():
+            self._check_func(mi, fi, root)
+        return self.findings
+
+    def _check_func(self, mi: ModuleInfo, fi: FuncInfo, root: str) -> None:
+        if fi.qual in self._seen:
+            return
+        self._seen.add(fi.qual)
+        locals_ = self._lg._local_types(mi, fi)
+        body = fi.node.body
+        if not isinstance(body, list):       # Lambda: body is an expr
+            body = [ast.Expr(value=body)]
+        module_names = mi.module_globals
+        local_names = {a.arg for a in fi.node.args.args
+                       + fi.node.args.kwonlyargs}
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Global):
+                    self._flag(mi, fi, root, node, "mutable-global",
+                               f"`global {', '.join(node.names)}`")
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        self._check_store(mi, fi, root, t, module_names,
+                                          local_names)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local_names.add(t.id)
+                if isinstance(node, ast.AugAssign):
+                    self._check_store(mi, fi, root, node.target,
+                                      module_names, local_names)
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lk = self._lg._lock_of_expr(mi, fi, locals_,
+                                                    item.context_expr)
+                        if lk is not None:
+                            self._flag(mi, fi, root, node, "lock",
+                                       f"acquires {lk}")
+                if not isinstance(node, ast.Call):
+                    continue
+                self._check_call(mi, fi, root, node, locals_)
+
+    def _check_store(self, mi, fi, root, target, module_names,
+                     local_names) -> None:
+        """Store to a module-level name or into a module-level
+        container is a trace-time-only side effect."""
+        # without a `global` declaration, a bare-name assignment is a
+        # LOCAL — only mutation THROUGH a module-level name (subscript
+        # or attribute store) reaches module state
+        base = target
+        sub = False
+        while isinstance(base, (ast.Subscript, ast.Attribute)):
+            sub = True
+            base = base.value
+        if not sub or not isinstance(base, ast.Name) \
+                or base.id == "self":
+            return
+        if base.id in local_names:
+            return
+        if base.id in module_names:
+            self._flag(mi, fi, root, target, "mutable-global",
+                       f"writes into module-level `{base.id}`")
+
+    def _check_call(self, mi: ModuleInfo, fi: FuncInfo, root: str,
+                    node: ast.Call, locals_) -> None:
+        d = _dotted(node.func) or ""
+        head, leaf = (d.split(".")[0], d.split(".")[-1]) if d else ("", "")
+        if head == "time" and leaf in _WALL_CLOCK:
+            self._flag(mi, fi, root, node, "wall-clock", f"calls {d}")
+            return
+        if leaf in ("fault_point",) or (
+                head in ("global_injector",) and leaf == "check"):
+            self._flag(mi, fi, root, node, "fault-point", f"calls {d}")
+            return
+        if head == "global_metrics" or (
+                head == "threading" and leaf in ("Lock", "RLock",
+                                                 "Condition")):
+            kind = ("metrics" if head == "global_metrics" else "lock")
+            self._flag(mi, fi, root, node, kind, f"calls {d}")
+            return
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "acquire":
+            lk = self._lg._lock_of_expr(mi, fi, locals_, node.func.value)
+            if lk is not None:
+                self._flag(mi, fi, root, node, "lock", f"acquires {lk}")
+                return
+        # recurse into resolvable package callees
+        for target in self._lg._resolve_call(mi, fi, locals_, node):
+            tmod = self.tree.modules[target.module]
+            if target.qual.startswith("utils.metrics.Metrics."):
+                self._flag(mi, fi, root, node, "metrics",
+                           f"calls {target.qual}")
+                continue
+            if target.qual.startswith("utils.faults."):
+                self._flag(mi, fi, root, node, "fault-point",
+                           f"calls {target.qual}")
+                continue
+            self._check_func(tmod, target, root)
+
+    def _flag(self, mi: ModuleInfo, fi: FuncInfo, root: str,
+              node: ast.AST, category: str, what: str) -> None:
+        self.findings.append(Finding(
+            "jitpurity",
+            f"jitpurity:{category}:{fi.qual}",
+            f"impure under jit (entry {root}): {fi.qual} {what} — "
+            f"side effects under a tracer run once at trace time "
+            f"(or force retraces), never per call",
+            mi.relpath, getattr(node, "lineno", 0)))
+
+
+def analyze(tree: SourceTree) -> list[Finding]:
+    return _Purity(tree).check()

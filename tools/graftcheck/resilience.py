@@ -1,0 +1,112 @@
+"""Resilience-coverage analysis: no naked leader→worker RPCs.
+
+Every leader→worker RPC must flow through
+``ClusterResilience.worker_call`` (breaker + bounded retry) — a new raw
+``urlopen`` / ``http_post`` / ``http_get`` / ``_ScatterClient.post`` /
+``_post_json`` call in ``cluster/`` that is NOT wrapped is a finding.
+
+A raw transport call counts as wrapped when it sits lexically inside a
+closure handed to ``worker_call``: a ``lambda`` argument of a
+``worker_call(...)`` call, or a nested ``def`` whose name appears as a
+``worker_call`` argument in the same enclosing function. Subsystems
+with their own failure discipline (the coordination client's
+connect-string failover, Raft replication's term-checked resend loop,
+heartbeats) are pinned in ``allowlist.json`` with reasons — new call
+sites in them still surface here first.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftcheck.core import Finding, SourceTree, _dotted
+
+_RAW_TRANSPORTS = {"urlopen", "http_post", "http_get", "_post_json"}
+_RAW_METHODS = {"post"}         # self._scatter.post
+_WRAPPER = "worker_call"
+
+
+def _transport_call(node: ast.Call) -> str | None:
+    d = _dotted(node.func)
+    if d is None:
+        return None
+    leaf = d.split(".")[-1]
+    if leaf in _RAW_TRANSPORTS:
+        return leaf
+    if leaf in _RAW_METHODS and "_scatter" in d:
+        return d
+    return None
+
+
+def _wrapped_names(func: ast.AST) -> set[str]:
+    """Names of nested defs passed to worker_call within ``func``."""
+    out: set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func) or ""
+            if d.split(".")[-1] == _WRAPPER:
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        out.add(a.id)
+    return out
+
+
+def _lambda_wrapped(module: ast.Module) -> set[ast.AST]:
+    """All nodes inside lambdas that are worker_call arguments."""
+    covered: set[ast.AST] = set()
+    for node in ast.walk(module):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func) or ""
+            if d.split(".")[-1] == _WRAPPER:
+                for a in node.args:
+                    if isinstance(a, ast.Lambda):
+                        covered.update(ast.walk(a))
+    return covered
+
+
+def analyze(tree: SourceTree) -> list[Finding]:
+    out: list[Finding] = []
+    for mi in tree.modules.values():
+        if not mi.name.startswith("cluster."):
+            continue
+        lambda_cov = _lambda_wrapped(mi.tree)
+        # map: every FunctionDef node -> its enclosing chain of defs
+        chains: dict[ast.AST, list[ast.FunctionDef]] = {}
+
+        def index(node: ast.AST, chain: list[ast.FunctionDef]) -> None:
+            if isinstance(node, ast.FunctionDef):
+                chain = chain + [node]
+            for child in ast.iter_child_nodes(node):
+                chains[child] = chain
+                index(child, chain)
+
+        chains[mi.tree] = []
+        index(mi.tree, [])
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            transport = _transport_call(node)
+            if transport is None:
+                continue
+            if node in lambda_cov:
+                continue
+            chain = chains.get(node, [])
+            covered = False
+            qual_parts = [f.name for f in chain]
+            if chain:
+                inner = chain[-1]
+                for encl in chain[:-1]:
+                    if inner.name in _wrapped_names(encl):
+                        covered = True
+                        break
+            if covered:
+                continue
+            qual = f"{mi.name}." + ".".join(qual_parts or ["<module>"])
+            out.append(Finding(
+                "resilience",
+                f"resilience:unwrapped:{qual}:{transport}",
+                f"raw transport call {transport!r} in {qual} does not "
+                f"flow through ClusterResilience.worker_call "
+                f"(no breaker, no bounded retry)",
+                mi.relpath, node.lineno))
+    return out

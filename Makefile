@@ -12,6 +12,13 @@
 #                     restart of substrate members (subprocess
 #                     coordinators) mid-traffic
 #                     (tests/test_coordination_durability.py -m slow)
+#   make chaos-replica  slow replication chaos job: kill -9 a worker
+#                     subprocess mid-workload under churn, assert every
+#                     in-flight and subsequent search returns the
+#                     complete result set in exact parity with a
+#                     single-node oracle; plus SIGKILL of the whole
+#                     coordinator ensemble with the placement map
+#                     intact (tests/test_replication.py -m slow)
 #   make faults       list every registered fault point (chaos configs
 #                     should be validated against this — see
 #                     utils/faults.py)
@@ -33,8 +40,8 @@
 
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test chaos chaos-coord faults bench probe-overlap graftcheck \
-        lockdep check
+.PHONY: test chaos chaos-coord chaos-replica faults bench probe-overlap \
+        graftcheck lockdep check
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -52,7 +59,8 @@ graftcheck:
 lockdep:
 	JAX_PLATFORMS=cpu GRAFTCHECK_LOCKDEP=1 python -m pytest \
 	  tests/test_resilience.py tests/test_cluster.py \
-	  tests/test_graftcheck.py $(PYTEST_FLAGS) -m 'not slow'
+	  tests/test_replication.py tests/test_graftcheck.py \
+	  $(PYTEST_FLAGS) -m 'not slow'
 
 check: graftcheck test
 
@@ -61,6 +69,9 @@ chaos:
 
 chaos-coord:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_coordination_durability.py $(PYTEST_FLAGS) -m slow
+
+chaos-replica:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_replication.py $(PYTEST_FLAGS) -m slow
 
 faults:
 	python -m tfidf_tpu faults list

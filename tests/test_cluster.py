@@ -197,6 +197,10 @@ def cluster(core, tmp_path):
             documents_path=str(tmp_path / f"node{i}" / "documents"),
             index_path=str(tmp_path / f"node{i}" / "index"),
             port=0, result_order="name",
+            # single-copy placement: this suite pins the reference's
+            # one-copy-per-doc semantics (spread, upsert routing,
+            # partial tolerance); R-way placement has its own suite
+            replication_factor=1,
             min_doc_capacity=64, min_nnz_capacity=1 << 12,
             min_vocab_capacity=1 << 10, query_batch=4, max_query_terms=8)
         node = SearchNode(cfg, coord=LocalCoordination(core, 0.1))
@@ -299,7 +303,7 @@ class TestClusterEndToEnd:
         assert names <= {d["name"] for d in docs}
         # re-upload an existing name: routes to the SAME worker (upsert,
         # not duplicate) — placement map, ADVICE r2
-        orig = leader._placement["bulk0.txt"]
+        orig = leader._placement["bulk0.txt"][0]
         one = [{"name": "bulk0.txt", "text": "entirely new content"}]
         resp2 = json.loads(http_post(leader.url + "/leader/upload-batch",
                                      json.dumps(one).encode()))
@@ -327,8 +331,8 @@ class TestClusterEndToEnd:
                       json.dumps(bad).encode())
         assert ei.value.code == 400
         assert "leaky.txt" not in leader._placement
-        assert "leaky.txt" not in leader._inflight
-        assert "leaky.txt" not in leader._claims
+        assert not any(n == "leaky.txt"
+                       for n, _w in leader.placement._inflight)
         # the name is still placeable afterwards
         ok = [{"name": "leaky.txt", "text": "quokka sighting report"}]
         resp = json.loads(http_post(leader.url + "/leader/upload-batch",
@@ -339,30 +343,30 @@ class TestClusterEndToEnd:
         assert list(result) == ["leaky.txt"]
 
     def test_settle_failure_cleans_phantom_placement(self, cluster):
-        """When EVERY concurrent upload of a new name fails, the
-        tentative placement must not survive: a held-routed sibling
-        (token=None) settling last cleans up the unconfirmed claim
-        (code-review r4)."""
+        """When EVERY upload leg of a new name fails, the tentative
+        placement must not survive: the last failing leg of a
+        never-confirmed replica drops the phantom entry, so retries can
+        re-place the name anywhere (code-review r4, generalized to
+        R-way legs in cluster/placement.py)."""
         leader = cluster[0]
         # registry read BEFORE taking the placement lock: production
         # never nests these, and the lockdep witness holds tests to the
         # same ordering discipline as the code under test
         w = leader.registry.get_all_service_addresses()[0]
+        pm = leader.placement
         with leader._placement_lock:
-            tok = object()
-            leader._placement["ghost.txt"] = w
-            leader._claims["ghost.txt"] = tok
-            leader._track_inflight("ghost.txt")   # claimer in flight
-            leader._track_inflight("ghost.txt")   # held-routed sibling
-            # claimer fails first: sibling still in flight, keep state
-            leader._settle_failure("ghost.txt", tok, w)
-            assert "ghost.txt" in leader._placement
-            # sibling (token=None) fails last: unconfirmed claim means
-            # the placement was never accepted anywhere — drop both
-            leader._settle_failure("ghost.txt", None, w)
-            assert "ghost.txt" not in leader._placement
-            assert "ghost.txt" not in leader._claims
-            assert "ghost.txt" not in leader._inflight
+            reps, new = pm.route_locked("ghost.txt", [w], {w: 0},
+                                        None, 1)
+            assert reps == (w,) and new
+            pm._track_leg("ghost.txt", w)   # concurrent sibling leg
+        # first leg fails: the sibling is still in flight, keep state
+        pm.leg_failure("ghost.txt", w)
+        assert "ghost.txt" in leader._placement
+        # sibling leg fails last: no leg ever confirmed — drop the
+        # phantom placement entirely
+        pm.leg_failure("ghost.txt", w)
+        assert "ghost.txt" not in leader._placement
+        assert not any(n == "ghost.txt" for n, _w in pm._inflight)
 
     def test_large_download_streams_with_bounded_reads(self, cluster):
         """A big document flows worker -> leader -> client in bounded

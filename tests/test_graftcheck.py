@@ -243,6 +243,50 @@ class Node:
         assert "cluster.rpc.wrapped" not in quals, quals
         assert "cluster.rpc.wrapped_lambda" not in quals, quals
 
+    def test_closure_forwarding_wrapper_recognized(self, tmp_path):
+        """The replication spine's indirection: a per-worker RPC
+        closure handed to a gatherer that forwards it into worker_call
+        (``_gather(..., rpc_one, ...)``) is wrapped; a replica-failover
+        RPC that bypasses both is still a finding."""
+        tree = _mini_tree(tmp_path, {"cluster/rpc.py": '''
+import urllib.request
+
+class Node:
+    def _gather(self, queries, rpc_one, deadline):
+        def call(addr):
+            return self.resilience.worker_call(
+                addr, lambda: rpc_one(addr, deadline))
+        return [call(w) for w in self.workers]
+
+    def scatter(self, queries):
+        def rpc_one(addr, deadline):
+            return urllib.request.urlopen(addr + "/worker/process")
+        return self._gather(queries, rpc_one, 1.0)
+
+    def naked_failover(self, backup, names):
+        def slice_rpc():
+            return urllib.request.urlopen(backup + "/worker/slice")
+        return slice_rpc()
+'''})
+        found = resilience.analyze(tree)
+        quals = {f.key.split(":")[2] for f in found}
+        assert "cluster.rpc.scatter.rpc_one" not in quals, quals
+        assert "cluster.rpc.naked_failover.slice_rpc" in quals, quals
+
+    def test_keyword_passed_closure_counts_as_wrapped(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"cluster/rpc.py": '''
+import urllib.request
+
+class Node:
+    def kw_wrapped(self, w):
+        def rpc():
+            return urllib.request.urlopen(w)
+        return self.resilience.worker_call(w, fn=rpc)
+'''})
+        found = resilience.analyze(tree)
+        quals = {f.key.split(":")[2] for f in found}
+        assert "cluster.rpc.kw_wrapped.rpc" not in quals, quals
+
 
 # ---------------------------------------------------------------------------
 # 2. the real tree: the committed pins are the whole story
@@ -281,8 +325,10 @@ class TestRealTree:
                 "cluster.coordination.CoordinationCore._lock") in edges
         assert ("cluster.coordination.CoordinationCore._lock",
                 "cluster.coordination._Session.cond") in edges
+        # _placement_lock is an alias of the placement map's own lock
+        # (cluster/placement.py) — the resolver sees through it
         assert ("cluster.node.SearchNode._reconcile_serial",
-                "cluster.node.SearchNode._placement_lock") in edges
+                "cluster.placement.PlacementMap.lock") in edges
 
     def test_lock_sites_cover_known_locks(self, graph):
         names = set(graph.tree.lock_sites.values())

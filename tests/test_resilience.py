@@ -283,7 +283,11 @@ _RESILIENCE_CFG = dict(
     min_vocab_capacity=1 << 10, query_batch=8, max_query_terms=8,
     rpc_max_attempts=1,           # deterministic: no hidden retries
     breaker_failure_threshold=2, breaker_reset_s=0.4,
-    reconcile_sweep_interval_s=0.2)
+    reconcile_sweep_interval_s=0.2,
+    # single-copy placement: this suite pins the PRE-replication
+    # degraded/recovery semantics (R-way failover has its own suite,
+    # tests/test_replication.py)
+    replication_factor=1)
 
 
 def _node(core, tmp_path, i, port=0, **kw):
@@ -365,8 +369,8 @@ class TestHonestFailurePropagation:
             full = set(_search(leader, "common"))
             assert full == set(DOCS)
             victim = w1
-            victim_names = {n for n, w in leader._placement.items()
-                            if w == victim.url}
+            victim_names = {n for n, ws in leader._placement.items()
+                            if victim.url in ws}
             assert victim_names and victim_names != set(DOCS)
 
             def broken(queries, k=None, unbounded=False):
@@ -399,7 +403,10 @@ class TestHonestFailurePropagation:
 
 class TestBreakerEndToEnd:
     def test_open_halfopen_close_with_bounded_fires(self, core, tmp_path):
-        nodes = _mk_cluster(core, tmp_path, n=3)
+        # reset_s wide enough that a suite-load-slowed search cannot
+        # reach the half-open window mid-test and admit a probe RPC —
+        # the exact fire-count asserts below depend on it
+        nodes = _mk_cluster(core, tmp_path, n=3, breaker_reset_s=2.0)
         try:
             leader = nodes[0]
             _upload_docs(leader)
@@ -461,8 +468,8 @@ class TestReconcileSweep:
 
             victim = nodes[1]
             victim_port = victim.port
-            victim_names = {n for n, w in leader._placement.items()
-                            if w == victim.url}
+            victim_names = {n for n, ws in leader._placement.items()
+                            if victim.url in ws}
             assert victim_names
             # kill the victim; recovery re-places its shard
             victim.httpd.shutdown()
@@ -470,8 +477,8 @@ class TestReconcileSweep:
             core.expire_session(victim.coord.sid)
             assert wait_until(
                 lambda: set(_search(leader, "common")) == set(DOCS)
-                and set(leader._placement.values())
-                == {nodes[2].url}, timeout=10.0)
+                and {w for ws in leader._placement.values()
+                     for w in ws} == {nodes[2].url}, timeout=10.0)
             want = _search(leader, "common")
 
             # arm: EVERY /worker/delete dies (covers the join-event
@@ -714,8 +721,8 @@ class TestChaos:
             core.expire_session(victim.coord.sid)
             assert wait_until(
                 lambda: set(_search(leader, "common")) == set(DOCS)
-                and set(leader._placement.values())
-                == {nodes[2].url}, timeout=10.0)
+                and {w for ws in leader._placement.values()
+                     for w in ws} == {nodes[2].url}, timeout=10.0)
             want = _search(leader, "common")
 
             global_injector.arm("leader.reconcile_rpc", action="raise",

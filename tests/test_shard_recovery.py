@@ -25,10 +25,13 @@ def core():
 
 
 def _node(core, tmp_path, i, port=0):
+    # replication_factor=1: this suite covers the SINGLE-COPY recovery
+    # machinery (re-placement from the durable store); the replicated
+    # failover path is tests/test_replication.py
     cfg = Config(
         documents_path=str(tmp_path / f"sr{i}" / "documents"),
         index_path=str(tmp_path / f"sr{i}" / "index"),
-        port=port, top_k=32,
+        port=port, top_k=32, replication_factor=1,
         min_doc_capacity=64, min_nnz_capacity=1 << 12,
         min_vocab_capacity=1 << 10, query_batch=8, max_query_terms=8)
     return SearchNode(cfg, coord=LocalCoordination(core, 0.1)).start()
@@ -62,8 +65,8 @@ def test_worker_loss_replaces_shard_and_rejoin_reconciles(core, tmp_path):
 
         victim = nodes[1]
         victim_port = victim.port
-        victim_names = {n for n, w in leader._placement.items()
-                        if w == victim.url}
+        victim_names = {n for n, ws in leader._placement.items()
+                        if victim.url in ws}
         assert victim_names   # placement spread over both workers
         survivor_names = set(DOCS) - victim_names
 
@@ -88,7 +91,7 @@ def test_worker_loss_replaces_shard_and_rejoin_reconciles(core, tmp_path):
         assert metrics().get("shard_docs_replaced", 0) >= len(victim_names)
         # placement now maps every doc to the survivor
         with leader._placement_lock:
-            holders = {leader._placement[n] for n in DOCS}
+            holders = {w for n in DOCS for w in leader._placement[n]}
         assert holders == {nodes[2].url}
         want_scores = _search_names(leader, "common")[1]
 
@@ -125,6 +128,7 @@ def test_recovery_disabled_keeps_reference_behavior(core, tmp_path):
                 documents_path=str(tmp_path / f"nr{i}" / "documents"),
                 index_path=str(tmp_path / f"nr{i}" / "index"),
                 port=0, shard_recovery=False, top_k=32,
+                replication_factor=1,
                 min_doc_capacity=64, min_nnz_capacity=1 << 12,
                 min_vocab_capacity=1 << 10, query_batch=8,
                 max_query_terms=8)
@@ -138,8 +142,8 @@ def test_recovery_disabled_keeps_reference_behavior(core, tmp_path):
             http_post(leader.url + f"/leader/upload?name={n}", t.encode(),
                       content_type="application/octet-stream")
         victim = nodes[1]
-        victim_names = {n for n, w in leader._placement.items()
-                        if w == victim.url}
+        victim_names = {n for n, ws in leader._placement.items()
+                        if victim.url in ws}
         core.expire_session(victim.coord.sid)
         assert wait_until(lambda: leader.registry
                           .get_all_service_addresses()

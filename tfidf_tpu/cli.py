@@ -380,6 +380,21 @@ def cmd_status(args) -> int:
                    if s != "closed"),
     }
     out["degraded"] = degraded
+    # replication summary (README "Replication & failover semantics"):
+    # how often failed owners' slices failed over to surviving replicas,
+    # whether any document currently has no live scorer, and what the
+    # anti-entropy repair has moved
+    out["replication"] = {
+        "last_scatter_failovers":
+            int(metrics.get("scatter_last_failovers", 0)),
+        "last_scatter_dark_docs":
+            int(metrics.get("scatter_last_dark", 0)),
+        "failover_reads_total": int(metrics.get("scatter_failovers", 0)),
+        "hedge_wins_total": int(metrics.get("scatter_hedge_wins", 0)),
+        "repair_docs_replicated":
+            int(metrics.get("repair_docs_replicated", 0)),
+        "repair_docs_trimmed": int(metrics.get("repair_docs_trimmed", 0)),
+    }
     print(json.dumps(out, indent=2))
     return 0
 

@@ -16,8 +16,14 @@ The TPU-native replacement for the reference's coordination stack
   coordinator API (``leader/Leader.java``, ``worker/Worker.java``,
   ``controller/Controllers.java``).
 - :mod:`resilience` — the failure discipline shared by every
-  leader->worker RPC path: bounded retry with backoff + jitter, and
-  per-worker circuit breakers (closed/open/half-open).
+  leader->worker RPC path: bounded retry with backoff + jitter,
+  per-worker circuit breakers (closed/open/half-open), and the
+  hedged-read laggard detector.
+- :mod:`placement` — R-way document placement: the replica map with
+  per-leg upload bookkeeping, per-query ownership assignment (exactly
+  one live replica scores each document), and durable persistence of
+  the map through the coordination substrate so leader failover keeps
+  exact ownership.
 - :mod:`wal` — L0 durability: CRC-framed write-ahead log, atomic
   snapshots of the znode tree + session table, and log compaction, so a
   crashed coordinator restarts with its full state.
@@ -36,12 +42,14 @@ from tfidf_tpu.cluster.registry import ServiceRegistry
 from tfidf_tpu.cluster.resilience import (BreakerBoard, CircuitBreaker,
                                           CircuitOpenError, RetryPolicy)
 from tfidf_tpu.cluster.node import SearchNode
+from tfidf_tpu.cluster.placement import PlacementMap
 from tfidf_tpu.cluster.wal import DurableStore
 from tfidf_tpu.cluster.ensemble import EnsembleNode
 
 __all__ = [
     "CoordinationCore", "CoordinationServer", "CoordinationClient",
     "LocalCoordination", "Event", "LeaderElection", "OnElectionCallback",
-    "ServiceRegistry", "SearchNode", "RetryPolicy", "CircuitBreaker",
+    "ServiceRegistry", "SearchNode", "PlacementMap", "RetryPolicy",
+    "CircuitBreaker",
     "CircuitOpenError", "BreakerBoard", "DurableStore", "EnsembleNode",
 ]

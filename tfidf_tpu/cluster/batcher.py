@@ -28,7 +28,7 @@ log = get_logger("cluster.batcher")
 
 
 class _Waiter:
-    __slots__ = ("query", "event", "result", "error", "t0")
+    __slots__ = ("query", "event", "result", "error", "t0", "key")
 
     def __init__(self, query) -> None:
         self.query = query   # the submitted item (any shape)
@@ -36,6 +36,7 @@ class _Waiter:
         self.result = None
         self.error: BaseException | None = None
         self.t0 = 0.0   # submit time (linger accounting)
+        self.key = None  # group key, stamped at SUBMIT time
 
 
 class Coalescer:
@@ -57,7 +58,11 @@ class Coalescer:
                  linger_max_s: float | None = None) -> None:
         """``group_key(item)``, when given, keeps a batch homogeneous:
         only leading queued items sharing the head's key join it; the
-        rest stay queued in order for the next dispatcher round.
+        rest stay queued in order for the next dispatcher round. The
+        key is evaluated ONCE, at submit time — so a key derived from
+        ambient state (the leader's membership epoch) partitions
+        batches by the world the caller saw, not by whatever the
+        dispatcher sees later.
 
         ``linger_min_s``/``linger_max_s`` arm the ADAPTIVE linger: with
         no batch in flight the dispatcher lingers only ``linger_min_s``
@@ -89,6 +94,8 @@ class Coalescer:
     def submit(self, item):
         w = _Waiter(item)
         w.t0 = time.perf_counter()
+        if self.group_key is not None:
+            w.key = self.group_key(item)
         with self._lock:
             if self._stopping:
                 raise RuntimeError(f"{self.name} stopped")
@@ -185,12 +192,10 @@ class Coalescer:
                 if self._items:
                     first = self._items.popleft()
                     batch.append(first)
-                    key = (self.group_key(first.query)
-                           if self.group_key else None)
+                    key = first.key   # stamped at submit time
                     while (self._items and len(batch) < self.max_batch
                            and (self.group_key is None
-                                or self.group_key(self._items[0].query)
-                                == key)):
+                                or self._items[0].key == key)):
                         batch.append(self._items.popleft())
                 if not self._items and not self._stopping:
                     # never clear after stop() set the event, or sibling

@@ -41,16 +41,21 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
+from concurrent.futures import wait as _fwait
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
 from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
                                     unpack_hit_lists)
 from tfidf_tpu.cluster.election import LeaderElection
+from tfidf_tpu.cluster.placement import PlacementMap
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
 from tfidf_tpu.cluster.resilience import (CircuitOpenError,
-                                          ClusterResilience, RpcStatusError)
+                                          ClusterResilience,
+                                          DeadlineExpired, RpcStatusError,
+                                          hedge_laggards)
 from tfidf_tpu.engine.engine import Engine
 from tfidf_tpu.ops.analyzer import UnsupportedMediaType
 from tfidf_tpu.utils.config import Config
@@ -98,7 +103,8 @@ class _ScatterClient:
         self._tls = threading.local()
 
     def post(self, base: str, path: str, data: bytes,
-             timeout: float = 10.0, live: set[str] | None = None) -> bytes:
+             timeout: float = 10.0, live: set[str] | None = None,
+             headers: dict[str, str] | None = None) -> bytes:
         import http.client
         u = urllib.parse.urlparse(base)
         conns = getattr(self._tls, "conns", None)
@@ -137,15 +143,21 @@ class _ScatterClient:
                     c.sock.setsockopt(_socket.IPPROTO_TCP,
                                       _socket.TCP_NODELAY, 1)
                     conns[base] = c
-                c.request("POST", path, body=data, headers={
-                    "Content-Type": "application/json"})
+                h = {"Content-Type": "application/json"}
+                h.update(headers or {})
+                c.request("POST", path, body=data, headers=h)
                 r = c.getresponse()
                 body = r.read()
                 if r.status >= 300:
                     # typed status error: the resilience layer retries
                     # gateway-transient statuses (502/503/504), never
-                    # 4xx (application) or deterministic 500s
-                    raise RpcStatusError(f"{base}{path}", r.status)
+                    # 4xx (application), deterministic 500s, or a
+                    # worker's honest deadline refusal (the budget
+                    # cannot come back — see X-Deadline-Ms)
+                    raise RpcStatusError(
+                        f"{base}{path}", r.status,
+                        deadline_exceeded=(
+                            r.getheader("X-Deadline-Exceeded") == "1"))
                 return body
             except RuntimeError:
                 raise
@@ -167,6 +179,13 @@ def http_post(url: str, data: bytes, content_type: str = "application/json",
     req = urllib.request.Request(url, data=data, headers=h)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return r.read()
+
+
+class WorkerDeadline(RuntimeError):
+    """The caller's propagated scatter budget (``X-Deadline-Ms``) ran
+    out before scoring began — the worker refuses to start, the handler
+    answers 504 + ``X-Deadline-Exceeded: 1``, and the leader's
+    resilience layer classifies that as non-retryable."""
 
 
 def _linger_bounds(min_ms: float, max_ms: float) -> dict:
@@ -216,6 +235,13 @@ class SearchNode:
         self._pool = ThreadPoolExecutor(
             max_workers=self.config.fanout_workers,
             thread_name_prefix="fanout")
+        # failover/hedge slice re-issues get their OWN pool: on the
+        # shared fan-out pool they would queue behind the very laggard
+        # primaries they exist to race, turning hedging into a no-op
+        # exactly under the saturation it targets
+        self._slice_pool = ThreadPoolExecutor(
+            max_workers=max(4, self.config.fanout_workers // 2),
+            thread_name_prefix="slice")
         self._scatter = _ScatterClient()
         # concurrent /worker/process requests coalesce into one device
         # batch (the kernels are built for [B] batches; the reference
@@ -232,11 +258,16 @@ class SearchNode:
         # _scatter_search_batch). The reference fans out one JSON RPC per
         # (query, worker) — Leader.java:51-70 — whose per-query Python
         # cost caps the distributed path far below the engine beneath it.
+        # per-owner-set batch keys: the group key is the membership
+        # epoch at SUBMIT time, so one coalesced batch never mixes
+        # queries from before and after a membership transition — each
+        # dispatched batch maps onto exactly one ownership world view
         self.scatter_batcher = (Coalescer(
             self._scatter_search_batch,
             max_batch=self.config.scatter_batch,
             linger_s=self.config.scatter_linger_ms / 1e3,
             pipeline=self.config.scatter_pipeline, name="scatter",
+            group_key=lambda _q: self._cluster_epoch,
             **_linger_bounds(self.config.scatter_linger_min_ms,
                              self.config.scatter_linger_max_ms))
             if (self.config.scatter_micro_batch
@@ -255,26 +286,44 @@ class SearchNode:
         self._compile_retry_lock = threading.Lock()
         self._compile_retries_used: dict[int, int] = {}
         # leader-side upload placement: TTL cache over worker index
-        # sizes + in-tenure name->worker map (re-uploads route to the
-        # holder, keeping one copy per name; see leader_upload)
+        # sizes + the R-way replica map (re-uploads route to the
+        # holders, upserting every copy; see leader_upload and
+        # cluster/placement.py). The map is durable: the persister
+        # writes it through the coordination substrate so a NEW leader
+        # resumes with exact ownership + pending-reconcile state.
         self._size_cache: tuple[float, dict[str, int]] = (0.0, {})
         # worker -> monotonic eviction time: a poll STARTED before the
         # eviction carries pre-failure data for that worker and must not
         # resurrect it into the cache (see _ensure_sizes_fresh)
         self._evicted: dict[str, float] = {}
-        self._placement: dict[str, str] = {}
-        self._claims: dict[str, object] = {}   # in-flight claim tokens
-        self._inflight: dict[str, int] = {}    # uploads in flight per name
-        # shard recovery state (all guarded by _placement_lock):
-        # _moved — names re-placed AWAY from a dead worker, keyed by its
-        # URL; the rejoin reconciliation deletes exactly these from it.
-        # Reconciles themselves run one at a time (_reconcile_serial) so
-        # a rejoin cannot interleave with an in-flight recovery.
-        self._moved: dict[str, set[str]] = {}
+        self.placement = PlacementMap(
+            flush_ms=self.config.placement_flush_ms,
+            name=str(self.config.port))
+        self.placement.bind_store(lambda: self.coord)
+        # leadership fence on every flush (see PlacementMap.persist_gate)
+        self.placement.persist_gate = self.is_leader
+        # aliases kept for the lock-ordering discipline (and tests):
+        # _placement/_moved ARE the placement map's dicts, guarded by
+        # _placement_lock == placement.lock
+        self._placement_lock = self.placement.lock
+        self._placement = self.placement.replicas
+        self._moved = self.placement.moved
+        # Reconciles run one at a time (_reconcile_serial) so a rejoin
+        # cannot interleave with an in-flight recovery.
         self._reconcile_serial = threading.Lock()
+        # membership epoch: scatter batches group by the value at
+        # SUBMIT time, so one coalesced batch never spans a membership
+        # transition (one batch = one owner assignment's world view)
+        self._cluster_epoch = 0
         # retry policy + per-worker circuit breakers shared by every
         # leader->worker RPC path (cluster/resilience.py)
         self.resilience = ClusterResilience(self.config)
+        # workers that have EVER contributed unmapped (legacy
+        # sum-merge) hits: if one of them later fails, the map cannot
+        # vouch for its unmapped documents — the degraded marker stays
+        # honest even when no live worker echoes those docs (GIL-atomic
+        # dict ops; bounded by distinct worker URLs)
+        self._legacy_hit_workers: dict[str, float] = {}
         # last-observed scatter health (attempted / responded /
         # circuit-open) for the CLI summary; per-REQUEST markers are
         # returned by leader_search_with_health — the degraded header is
@@ -296,12 +345,6 @@ class SearchNode:
         # (that would double-count them in the scatter sum-merge)
         self._store_dir = os.path.join(self.config.index_path,
                                        "placed_docs")
-        # guards _placement + _size_cache against concurrent
-        # ThreadingHTTPServer upload handlers: without it two
-        # simultaneous uploads of the same NEW name can both miss the
-        # placement map and place duplicate copies on different
-        # workers — exactly the double-count the map exists to prevent
-        self._placement_lock = threading.Lock()
 
         # serving-node durability (the reference commits its Lucene index
         # on every upload, Worker.java:138): an on-demand /admin/checkpoint
@@ -337,6 +380,7 @@ class SearchNode:
             # are re-analyzed (idempotent upserts)
             self.engine.build_from_directory(
                 newer_than=rebuild_newer_than)
+        self.placement.start_persister()
         self.election.volunteer_for_leadership()
         self.election.reelect_leader()
         if self._ckpt_thread is not None:
@@ -387,11 +431,13 @@ class SearchNode:
 
     def stop(self) -> None:
         self._stopping = True
+        self.placement.stop()
         self.election.resign()
         self.registry.unregister_from_cluster()
         self.httpd.shutdown()
         self.httpd.server_close()
         self._pool.shutdown(wait=False)
+        self._slice_pool.shutdown(wait=False)
         if self.batcher is not None:
             self.batcher.stop()
         if self.scatter_batcher is not None:
@@ -428,15 +474,25 @@ class SearchNode:
         property of the compiled shape, not of one request."""
         return 1 << max(0, n_queries - 1).bit_length() if n_queries else 0
 
-    def _search_batch_guarded(self, n_queries: int, run):
+    def _search_batch_guarded(self, n_queries: int, run,
+                              deadline: float | None = None):
         """Shared wrapper for the batched-scatter entrypoints: NRT
         commit, timing, and the transient-compile retry. A failure
         matching the known transient remote-compile signature is
         retried once, with a per-bucket-size budget: a deterministic
         compile error (e.g. OOM at a new bucket) drains the budget and
         then propagates immediately instead of doubling every batch's
-        cost forever."""
+        cost forever.
+
+        ``deadline`` (monotonic seconds) is the leader's propagated
+        scatter budget: re-checked AFTER the NRT commit (which can eat
+        real time) and before every scoring attempt — a batch whose
+        caller already gave up must not burn device time nobody will
+        merge."""
         self.commit_if_dirty()
+        if deadline is not None and time.monotonic() > deadline:
+            global_metrics.inc("worker_deadline_refusals")
+            raise WorkerDeadline("scatter deadline passed before scoring")
         bucket = self._compile_bucket(n_queries)
         t0 = time.perf_counter()
         try:
@@ -463,7 +519,8 @@ class SearchNode:
         return out
 
     def worker_search_batch(self, queries: list[str],
-                            k: int | None = None) -> list[list]:
+                            k: int | None = None,
+                            deadline: float | None = None) -> list[list]:
         """Score an already-formed query batch (the leader's batched
         scatter RPC). Bypasses the micro-batcher — the batch needs no
         linger for company — and runs the engine's batch path directly;
@@ -473,10 +530,34 @@ class SearchNode:
         dispatch while batch A's packed top-k fetch is still on the
         wire — engine/pipeline.py)."""
         return self._search_batch_guarded(
-            len(queries), lambda: self.engine.search_batch(queries, k=k))
+            len(queries), lambda: self.engine.search_batch(queries, k=k),
+            deadline=deadline)
+
+    def worker_search_slice(self, queries: list[str],
+                            names: list[str],
+                            deadline: float | None = None
+                            ) -> list[list[tuple[str, float]]]:
+        """Score an ownership SLICE: every matching document among
+        ``names`` for each query (the leader's failover / hedged
+        re-issue of a dead owner's documents). Exact within the slice —
+        the full ranking is computed host-side and filtered, so a
+        sliced document can never be truncated out by documents outside
+        the slice."""
+        nameset = set(names)
+
+        def run() -> list[list[tuple[str, float]]]:
+            res = self.engine.search_batch(queries, unbounded=True)
+            return [[(h.name, h.score) for h in hits
+                     if h.name in nameset] for hits in res]
+
+        out = self._search_batch_guarded(len(queries), run,
+                                         deadline=deadline)
+        global_metrics.inc("worker_slice_rpcs")
+        return out
 
     def worker_search_batch_wire(self, queries: list[str],
-                                 k: int | None = None) -> bytes:
+                                 k: int | None = None,
+                                 deadline: float | None = None) -> bytes:
         """Batched scatter RPC -> packed wire reply bytes. Fast path:
         the local searcher's raw top-k arrays packed vectorized
         (``search_arrays`` + ``pack_topk_arrays`` — no per-hit
@@ -491,9 +572,11 @@ class SearchNode:
                             None) is not None):
             got = self._search_batch_guarded(
                 len(queries),
-                lambda: self.engine.search_batch_arrays(queries, k=k))
+                lambda: self.engine.search_batch_arrays(queries, k=k),
+                deadline=deadline)
         if got is None:   # mesh layouts / name-ordered parity configs
-            results = self.worker_search_batch(queries, k=k)
+            results = self.worker_search_batch(queries, k=k,
+                                               deadline=deadline)
             t0 = time.perf_counter()
             body = pack_hit_lists(results)
         else:
@@ -586,7 +669,7 @@ class SearchNode:
             return
         live = set(self.registry.get_all_service_addresses())
         with self._placement_lock:
-            known = set(self._placement.values())
+            known = {w for ws in self._placement.values() for w in ws}
         lost = known - live
         if lost:
             self._reconcile_membership(lost, set())
@@ -600,8 +683,74 @@ class SearchNode:
         publish_leader_info(self.coord, self.url)
         global_metrics.inc("elections_won")
         log.info("assumed leader role", url=self.url)
+        # resume ownership: load the durable placement map (and its
+        # pending-reconcile state) off-thread — this callback can run
+        # on the watch-dispatch thread, and the load is a coordination
+        # read that must not stall other clients' events
+        threading.Thread(target=self._resume_placement, daemon=True,
+                         name=f"placement-resume-{self.config.port}"
+                         ).start()
+
+    def _resume_placement(self) -> None:
+        """New-leader resume: merge the persisted placement map into
+        memory, then enable persistence (in that order — enabling first
+        could let an early flush clobber the znode before it is read),
+        reconcile any workers that died while no leader was watching,
+        and restore the replication factor.
+
+        The load is retried (bounded) and persistence stays DISABLED if
+        it never succeeds: flushing a near-empty in-memory map over the
+        predecessor's durable one would permanently strip failover
+        coverage from every document placed before this tenure — a
+        stale durable map is strictly better than a clobbered one."""
+        loaded = self.config.placement_flush_ms < 0   # nothing to load
+        if not loaded:
+            delay = 0.2
+            deadline = time.monotonic() + 30.0
+            while not self._stopping:
+                try:
+                    self.placement.load()
+                    loaded = True
+                    break
+                except Exception as e:
+                    log.warning("placement map load failed; retrying",
+                                err=repr(e))
+                    if time.monotonic() > deadline:
+                        break
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+        if loaded:
+            self.placement.set_persist_enabled(True)
+        else:
+            log.warning(
+                "placement map load kept failing; placement persistence "
+                "stays disabled this tenure (never overwrite the "
+                "durable map with an unloaded in-memory one)")
+        if self._stopping or not self.config.shard_recovery:
+            return
+        try:
+            if not self.is_leader():
+                return
+            live = set(self.registry.get_all_service_addresses())
+            with self._placement_lock:
+                known = {w for ws in self._placement.values()
+                         for w in ws}
+            lost = known - live
+            if lost:
+                self._reconcile_membership(lost, set())
+            else:
+                self.run_replication_repair()
+        except Exception as e:
+            log.warning("placement resume pass failed", err=repr(e))
 
     def on_worker(self) -> None:
+        # a worker must never write the leader's placement state, and
+        # a DEMOTED ex-leader must not carry its tenure's map into a
+        # possible later re-promotion — the durable znode (written by
+        # its successors) is newer than this node's memory, so the map
+        # resets and a re-election loads it fresh
+        self.placement.set_persist_enabled(False)
+        self.placement.reset_for_follower()
         self.registry.register_to_cluster(self.url)
         log.info("assumed worker role", url=self.url)
 
@@ -621,92 +770,83 @@ class SearchNode:
         ``scatter_micro_batch=False``."""
         return self.leader_search_with_health(query)[0]
 
+    # per-query JSON scatter budget (the reference's 10s RestTemplate
+    # default) — propagated to workers as X-Deadline-Ms like the
+    # batched path's scatter_timeout_s
+    _PER_QUERY_BUDGET_S = 10.0
+
     def leader_search_with_health(self, query: str
                                   ) -> tuple[dict[str, float], dict]:
         """``leader_search`` plus this request's OWN health marker —
-        ``(merged, {attempted, responded, circuit_open, degraded})``.
-        The handler stamps the degraded header from the returned value:
-        reading it back off shared node state would let two concurrent
-        scatters mislabel each other's replies."""
+        ``(merged, {attempted, responded, circuit_open, degraded,
+        failovers, dark})``. The handler stamps the degraded header
+        from the returned value: reading it back off shared node state
+        would let two concurrent scatters mislabel each other's
+        replies."""
         if self.scatter_batcher is not None:
             return self.scatter_batcher.submit(query)
-        workers = self.registry.get_all_service_addresses()
-        log.info("scatter search", query=query, workers=len(workers))
+        log.info("scatter search", query=query)
+        body = json.dumps({"query": query}).encode()
+        t_deadline = time.monotonic() + self._PER_QUERY_BUDGET_S
 
-        live = set(workers)
-        self.resilience.board.prune(live)
-        excluded = self._pending_reconcile()
+        def rpc_one(addr: str, live: set[str],
+                    deadline: float) -> list[list[tuple[str, float]]]:
+            global_injector.check("leader.worker_rpc")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # pre-dispatch: no RPC happens, so the breaker must
+                # record NOTHING (DeadlineExpired releases it)
+                raise DeadlineExpired(addr + ": budget spent")
+            hits = json.loads(self._scatter.post(
+                addr, "/worker/process", body, timeout=remaining,
+                live=live,
+                headers={"X-Deadline-Ms": str(int(remaining * 1e3))}))
+            return [[(h["document"]["name"], float(h["score"]))
+                     for h in hits]]
 
-        def one(addr: str) -> list:
-            def rpc() -> list:
-                global_injector.check("leader.worker_rpc")
-                body = json.dumps({"query": query}).encode()
-                return json.loads(self._scatter.post(
-                    addr, "/worker/process", body, timeout=10.0,
-                    live=live))
-            # breaker + bounded retry around the whole logical RPC
-            return self.resilience.worker_call(addr, rpc)
-
-        merged: dict[str, float] = {}
-        responded = circuit_open = 0
-        futures = {self._pool.submit(one, w): w for w in workers}
-        for fut, addr in futures.items():
-            try:
-                hits = fut.result()
-            except CircuitOpenError:
-                # fast-failed without an RPC: the worker's breaker is
-                # open — counted separately so the degraded marker can
-                # distinguish "skipped sick worker" from "RPC failed"
-                circuit_open += 1
-                global_metrics.inc("scatter_circuit_open")
-                continue
-            except Exception as e:
-                # per-worker tolerance (Leader.java:67-69)
-                global_metrics.inc("scatter_failures")
-                log.warning("worker failed during search", worker=addr,
-                            err=repr(e))
-                continue
-            responded += 1
-            skip = excluded.get(addr)
-            for hit in hits:
-                name = hit["document"]["name"]
-                if skip is not None and name in skip:
-                    # moved away but not yet reconciled off this
-                    # rejoiner: the survivor's copy already counts it —
-                    # merging both would double-count (ADVICE r5)
-                    global_metrics.inc("scatter_hits_excluded")
-                    continue
-                merged[name] = merged.get(name, 0.0) + float(hit["score"])
-        health = self._record_scatter_health(len(workers), responded,
-                                             circuit_open)
-        return self._order_merged(merged), health
+        merged, health = self._gather_merge([query], rpc_one, t_deadline)
+        return self._order_merged(merged[0]), health
 
     def _pending_reconcile(self) -> dict[str, frozenset]:
         """Names moved AWAY from each worker whose rejoin reconcile has
         not yet succeeded — excluded from that worker's merged hits so
         the double-count window closes at merge time, not only when the
-        sweep finally lands."""
-        with self._placement_lock:
-            return {w: frozenset(ns) for w, ns in self._moved.items()
-                    if ns}
+        sweep finally lands. (For MAPPED names the owner assignment
+        already ignores non-replica hits structurally; this exclusion
+        covers names outside the map, and keeps the counter honest.)"""
+        return self.placement.pending_moved()
 
     def _record_scatter_health(self, attempted: int, responded: int,
-                               circuit_open: int) -> dict:
+                               circuit_open: int, failovers: int = 0,
+                               dark: int = 0,
+                               uncovered_workers: int = 0) -> dict:
         """Publish one fan-out's health: gauges in /api/metrics plus a
         last-observed copy on the node (for the CLI summary). Returns
         the marker dict — the handler stamps the degraded header from
-        the RETURNED value, which belongs to this request alone."""
-        degraded = 1 if responded < attempted else 0
+        the RETURNED value, which belongs to this request alone.
+
+        ``degraded`` means the RESULTS may be incomplete — not merely
+        that a worker failed. A worker death fully absorbed by replica
+        failover (every orphaned document re-scored by a surviving
+        replica) yields a complete, non-degraded response; documents
+        with no live scorer (``dark``) or a failed worker outside the
+        placement map's knowledge keep the marker honest."""
+        degraded = 1 if (dark > 0 or uncovered_workers > 0) else 0
         health = {
             "attempted": attempted, "responded": responded,
-            "circuit_open": circuit_open, "degraded": degraded}
+            "circuit_open": circuit_open, "degraded": degraded,
+            "failovers": failovers, "dark": dark}
         self._scatter_health = health
         global_metrics.set_gauge("scatter_last_attempted", attempted)
         global_metrics.set_gauge("scatter_last_responded", responded)
         global_metrics.set_gauge("scatter_last_circuit_open", circuit_open)
+        global_metrics.set_gauge("scatter_last_failovers", failovers)
+        global_metrics.set_gauge("scatter_last_dark", dark)
         global_metrics.set_gauge("scatter_degraded", degraded)
         global_metrics.set_gauge("breaker_open_workers",
                                  self.resilience.board.open_count())
+        if failovers:
+            global_metrics.inc("scatter_failovers", failovers)
         if degraded:
             global_metrics.inc("degraded_responses")
         return health
@@ -729,72 +869,40 @@ class SearchNode:
             self, queries: list[str]) -> list[dict[str, float]]:
         """Batched scatter-gather: ONE ``/worker/process-batch`` RPC per
         worker for a whole coalesced query group, packed-binary replies
-        (:mod:`tfidf_tpu.cluster.wire`), per-query sum-merge at the
-        leader. Collapses the per-(query, worker) HTTP + JSON cost that
-        otherwise caps the distributed path (the reference pays it by
-        design, one RestTemplate POST per worker per query,
-        ``Leader.java:51-70``). Per-worker failures degrade to partial
-        results exactly like the per-query path."""
-        workers = self.registry.get_all_service_addresses()
-        live = set(workers)
-        self.resilience.board.prune(live)
-        excluded = self._pending_reconcile()
+        (:mod:`tfidf_tpu.cluster.wire`), per-query owner-merge at the
+        leader (:meth:`_gather_merge`). Collapses the per-(query,
+        worker) HTTP + JSON cost that otherwise caps the distributed
+        path (the reference pays it by design, one RestTemplate POST
+        per worker per query, ``Leader.java:51-70``). A failed worker's
+        ownership slice fails over to surviving replicas WITHIN this
+        request."""
         body = json.dumps({"queries": queries,
                            "k": self.config.top_k}).encode()
+        t_deadline = time.monotonic() + self.config.scatter_timeout_s
 
-        def one(addr: str) -> bytes:
-            def rpc() -> bytes:
-                global_injector.check("leader.worker_rpc")
-                t0 = time.perf_counter()
-                raw = self._scatter.post(
-                    addr, "/worker/process-batch", body,
-                    timeout=self.config.scatter_timeout_s, live=live)
-                global_metrics.observe("scatter_rpc",
-                                       time.perf_counter() - t0)
-                return raw
-            # breaker + bounded retry around the whole logical RPC; an
-            # engine failure now arrives as a 500 (honest propagation)
-            # and fails fast — only gateway-transient statuses retry
-            return self.resilience.worker_call(addr, rpc)
+        def rpc_one(addr: str, live: set[str],
+                    deadline: float) -> list[list[tuple[str, float]]]:
+            global_injector.check("leader.worker_rpc")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # the budget is already spent: fail locally instead of
+                # shipping a batch the worker will (rightly) refuse —
+                # and record nothing on the breaker (no RPC happened)
+                raise DeadlineExpired(addr + ": budget spent")
+            t0 = time.perf_counter()
+            raw = self._scatter.post(
+                addr, "/worker/process-batch", body,
+                timeout=remaining, live=live,
+                headers={"X-Deadline-Ms": str(int(remaining * 1e3))})
+            global_metrics.observe("scatter_rpc",
+                                   time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            hit_lists = unpack_hit_lists(raw)
+            global_metrics.observe("scatter_decode",
+                                   time.perf_counter() - t1)
+            return hit_lists
 
-        merged: list[dict[str, float]] = [{} for _ in queries]
-        responded = circuit_open = 0
-        futures = {self._pool.submit(one, w): w for w in workers}
-        for fut, addr in futures.items():
-            try:
-                raw = fut.result()
-                t0 = time.perf_counter()
-                hit_lists = unpack_hit_lists(raw)
-                global_metrics.observe("scatter_decode",
-                                       time.perf_counter() - t0)
-            except CircuitOpenError:
-                circuit_open += 1
-                global_metrics.inc("scatter_circuit_open")
-                continue
-            except Exception as e:
-                # per-worker tolerance (Leader.java:67-69) — a reply
-                # that fails wire validation degrades to partial
-                # results exactly like a failed RPC
-                global_metrics.inc("scatter_failures")
-                log.warning("worker failed during batch search",
-                            worker=addr, err=repr(e))
-                continue
-            if len(hit_lists) != len(queries):
-                global_metrics.inc("scatter_failures")
-                log.warning("batch reply length mismatch", worker=addr)
-                continue
-            responded += 1
-            skip = excluded.get(addr)
-            for m, hits in zip(merged, hit_lists):
-                for name, score in hits:
-                    if skip is not None and name in skip:
-                        # pending-reconcile copy on a rejoiner: the
-                        # survivor's copy already counts (ADVICE r5)
-                        global_metrics.inc("scatter_hits_excluded")
-                        continue
-                    m[name] = m.get(name, 0.0) + score
-        health = self._record_scatter_health(len(workers), responded,
-                                             circuit_open)
+        merged, health = self._gather_merge(queries, rpc_one, t_deadline)
         t0 = time.perf_counter()
         # one (result, health) pair per coalesced query: every caller in
         # the group shares this batch's fan-out, so each reply carries
@@ -802,6 +910,293 @@ class SearchNode:
         out = [(self._order_merged(m), health) for m in merged]
         global_metrics.observe("scatter_merge", time.perf_counter() - t0)
         return out
+
+    def _slice_call(self, addr: str, queries: list[str],
+                    names: list[str], t_deadline: float,
+                    live: set[str]) -> list[list[tuple[str, float]]]:
+        """Failover / hedged read: score the ``names`` ownership slice
+        on a surviving replica (one breaker-gated, retried logical
+        RPC). Exact within the slice — the worker computes the full
+        ranking host-side and filters, so no slice document can be
+        truncated out by documents outside it."""
+        def rpc() -> list[list[tuple[str, float]]]:
+            global_injector.check("leader.replica_rpc")
+            remaining = t_deadline - time.monotonic()
+            if remaining <= 0:
+                raise DeadlineExpired(addr + ": budget spent")
+            body = json.dumps({"queries": queries,
+                               "names": names}).encode()
+            raw = self._scatter.post(
+                addr, "/worker/process-batch", body,
+                timeout=remaining, live=live,
+                headers={"X-Deadline-Ms": str(int(remaining * 1e3))})
+            return unpack_hit_lists(raw)
+        return self.resilience.worker_call(addr, rpc)
+
+    def _gather_merge(self, queries: list[str], rpc_one,
+                      t_deadline: float
+                      ) -> tuple[list[dict[str, float]], dict]:
+        """The scatter/merge/failover spine shared by the per-query and
+        batched paths.
+
+        1. Compute this request's OWNER ASSIGNMENT: exactly one live,
+           breaker-closed replica scores each mapped document, so the
+           merge is double-count-free by construction.
+        2. Fan the queries out to every registered worker
+           (breaker-gated, retried, deadline-propagated ``rpc_one``).
+           With ``scatter_hedge_ms`` set, a laggard's ownership slice
+           is speculatively re-issued to the next replica while the
+           primary RPC is still outstanding.
+        3. Merge epoch 0: an owner's hits are ASSIGNED (not summed);
+           non-owner replica hits are dropped; names outside the map
+           keep the legacy sum-merge with pending-reconcile exclusion.
+        4. Failover (epoch 1): documents whose owner failed or was
+           breaker-open are re-issued — only the orphaned ownership
+           slice — to surviving replicas within this same request.
+           Hedge results are deduped by owner epoch: if the primary
+           answered after all, its epoch-0 hits win and the hedge is
+           discarded.
+        """
+        workers = self.registry.get_all_service_addresses()
+        live = set(workers)
+        self.resilience.board.prune(live)
+        excluded = self._pending_reconcile()
+        open_set = frozenset(w for w in workers
+                             if self.resilience.board.is_open(w))
+        view = self.placement.owner_assignment(frozenset(live), open_set)
+
+        def call(addr: str):
+            return self.resilience.worker_call(
+                addr, lambda: rpc_one(addr, live, t_deadline))
+
+        futures = {self._pool.submit(call, w): w for w in workers}
+
+        # hedged duplicate reads (The Tail at Scale): per laggard, the
+        # ownership slice goes to the next replica while the primary is
+        # still in flight; the merge below dedups by owner epoch
+        hedge_futs: dict[str, list[tuple[str, list[str], object]]] = {}
+        if self.config.scatter_hedge_ms > 0 and view.owned:
+            def dispatch_hedge(addr: str) -> None:
+                names = view.owned.get(addr)
+                if not names:
+                    return
+                global_injector.check("leader.hedge")
+                global_metrics.inc("scatter_hedges")
+                for backup, ns in self.placement.backups_for(
+                        names, exclude={addr}, live=live,
+                        avoid=open_set).items():
+                    hedge_futs.setdefault(addr, []).append(
+                        (backup, ns, self._slice_pool.submit(
+                            self._slice_call, backup, queries, ns,
+                            t_deadline, live)))
+            hedge_laggards(dict(futures),
+                           self.config.scatter_hedge_ms / 1e3,
+                           dispatch_hedge)
+
+        ok: dict[str, list] = {}
+        failed: set[str] = set()
+        circuit_open = 0
+        for fut, addr in futures.items():
+            try:
+                if addr in hedge_futs:
+                    # the laggard is raced by its hedge: wait for
+                    # WHICHEVER side lands first — a primary that
+                    # answered right after the hedge fired must not
+                    # stall behind a slower hedge slice. The primary
+                    # wins whenever it made it (owner-epoch dedup);
+                    # once every hedge settled it gets only a short
+                    # grace. An abandoned primary that lands later
+                    # still settles its breaker accounting in the pool
+                    # thread; its result is simply not merged.
+                    hset = {hf for _b, _ns, hf in hedge_futs[addr]}
+                    pending = {fut} | hset
+                    while fut in pending and len(pending) > 1:
+                        remaining = t_deadline - time.monotonic() + 30.0
+                        if remaining <= 0:
+                            break
+                        _done, pending = _fwait(
+                            pending, timeout=remaining,
+                            return_when=FIRST_COMPLETED)
+                    hedge_ok = any(
+                        hf.done() and not hf.cancelled()
+                        and hf.exception() is None for hf in hset)
+                    if fut.done() or hedge_ok:
+                        # primary landed, or a successful hedge stands
+                        # ready to supersede it after a short grace
+                        hit_lists = fut.result(timeout=0.05)
+                    else:
+                        # every hedge FAILED (e.g. the backup's breaker
+                        # is open): the hedge bought nothing — wait for
+                        # the still-in-budget primary like an unhedged
+                        # worker instead of abandoning a healthy reply
+                        try:
+                            hit_lists = fut.result(timeout=max(
+                                0.0, t_deadline - time.monotonic())
+                                + 30.0)
+                        except (FutureTimeout, TimeoutError) as e:
+                            raise RuntimeError(
+                                "scatter task stalled past deadline"
+                            ) from e
+                else:
+                    # bounded by the request deadline plus grace for
+                    # the retry policy's backoff sleeps (lockgraph
+                    # indefinite-wait audit: a hung pool task must not
+                    # wedge the scatter thread forever). Re-raised as a
+                    # plain failure so it is NOT mistaken for a hedge
+                    # win below.
+                    try:
+                        hit_lists = fut.result(timeout=max(
+                            0.0, t_deadline - time.monotonic()) + 30.0)
+                    except (FutureTimeout, TimeoutError) as e:
+                        raise RuntimeError(
+                            "scatter task stalled past deadline") from e
+            except (FutureTimeout, TimeoutError):
+                failed.add(addr)
+                won = any(
+                    hf.done() and not hf.cancelled()
+                    and hf.exception() is None
+                    for _b, _ns, hf in hedge_futs.get(addr, ()))
+                if won:
+                    global_metrics.inc("scatter_hedge_wins")
+                    log.info("hedge superseded laggard primary",
+                             worker=addr)
+                else:
+                    # every hedge failed too: this is a plain scatter
+                    # failure, not a win — keep the metrics honest
+                    global_metrics.inc("scatter_failures")
+                    log.warning("laggard primary abandoned with no "
+                                "successful hedge", worker=addr)
+                continue
+            except CircuitOpenError:
+                # fast-failed without an RPC: the worker's breaker is
+                # open — counted separately so the health marker can
+                # distinguish "skipped sick worker" from "RPC failed"
+                circuit_open += 1
+                failed.add(addr)
+                global_metrics.inc("scatter_circuit_open")
+                continue
+            except Exception as e:
+                # per-worker tolerance (Leader.java:67-69) — a reply
+                # that fails wire validation degrades exactly like a
+                # failed RPC; failover below recovers the mapped slice
+                failed.add(addr)
+                global_metrics.inc("scatter_failures")
+                log.warning("worker failed during search", worker=addr,
+                            err=repr(e))
+                continue
+            if len(hit_lists) != len(queries):
+                failed.add(addr)
+                global_metrics.inc("scatter_failures")
+                log.warning("batch reply length mismatch", worker=addr)
+                continue
+            ok[addr] = hit_lists
+
+        # ---- merge, epoch 0: owner hits + legacy sum for unmapped ----
+        owner = view.owner
+        legacy_addrs: set[str] = set()   # workers with unmapped hits
+        merged: list[dict[str, float]] = [{} for _ in queries]
+        for addr, hit_lists in ok.items():
+            skip = excluded.get(addr)
+            for m, hits in zip(merged, hit_lists):
+                for name, score in hits:
+                    own = owner.get(name)
+                    if own is not None:
+                        if own == addr:
+                            # exactly one owner scores each mapped doc:
+                            # assignment — the sum-merge cannot double-
+                            # count replicas by construction
+                            m[name] = float(score)
+                        elif skip is not None and name in skip:
+                            # pending-reconcile copy on a rejoiner,
+                            # already structurally ignored — counted so
+                            # operators see the exclusion is active
+                            global_metrics.inc("scatter_hits_excluded")
+                        continue
+                    if skip is not None and name in skip:
+                        # unmapped pending-reconcile copy: the
+                        # survivor's copy already counts (ADVICE r5)
+                        global_metrics.inc("scatter_hits_excluded")
+                        continue
+                    legacy_addrs.add(addr)
+                    m[name] = m.get(name, 0.0) + float(score)
+
+        # ---- failover, epoch 1: re-issue orphaned ownership slices ----
+        orphans = [n for n, w in owner.items() if w in failed]
+        recovered: set[str] = set()
+        if orphans:
+            orphan_set = set(orphans)
+            failed_backups: set[str] = set()
+
+            def consume_slice(backup: str, ns: list[str], fut) -> None:
+                try:
+                    hit_lists = fut.result(timeout=max(
+                        0.0, t_deadline - time.monotonic()) + 30.0)
+                except Exception as e:
+                    failed_backups.add(backup)
+                    global_metrics.inc("scatter_failover_failures")
+                    log.warning("failover slice failed", worker=backup,
+                                names=len(ns), err=repr(e))
+                    return
+                if len(hit_lists) != len(queries):
+                    failed_backups.add(backup)
+                    global_metrics.inc("scatter_failover_failures")
+                    return
+                ns_set = set(ns) & orphan_set
+                for m, hits in zip(merged, hit_lists):
+                    for name, score in hits:
+                        # owner-epoch dedup: only docs whose owner
+                        # actually failed, first slice writer wins
+                        if name in ns_set and name not in m:
+                            m[name] = float(score)
+                recovered.update(ns_set)
+
+            # phase 1 — hedges already in flight for failed primaries
+            # ARE the failover slices: consume their OUTCOMES first
+            for laggard, entries in hedge_futs.items():
+                if laggard not in failed:
+                    continue   # primary answered: epoch-0 wins
+                for backup, ns, fut in entries:
+                    if backup in failed:
+                        continue
+                    consume_slice(backup, ns, fut)
+            # phase 2 — anything a hedge did NOT actually deliver
+            # (never dispatched, or the hedge itself failed) gets a
+            # fresh slice to the next usable replica: a failed hedge
+            # must not suppress re-issue to a remaining live one
+            fresh = [n for n in orphans if n not in recovered]
+            if fresh:
+                fresh_pending = [
+                    (backup, ns, self._slice_pool.submit(
+                        self._slice_call, backup, queries, ns,
+                        t_deadline, live))
+                    for backup, ns in self.placement.backups_for(
+                        fresh, exclude=failed | failed_backups,
+                        live=live, avoid=open_set).items()]
+                for backup, ns, fut in fresh_pending:
+                    consume_slice(backup, ns, fut)
+
+        dark = len(view.dark) + len([n for n in orphans
+                                     if n not in recovered])
+        # a failed worker OUTSIDE the placement map may hold documents
+        # the map cannot fail over — stay honest and mark degraded.
+        # Same when unmapped documents are in play: legacy sum-merge
+        # hits flowing THIS request, or a failed worker that has EVER
+        # served unmapped hits (its copies may have been the only ones,
+        # so their absence right now proves nothing).
+        now = time.monotonic()
+        for a in legacy_addrs:
+            self._legacy_hit_workers[a] = now
+        uncovered_workers = sum(1 for w in failed
+                                if w not in view.replica_workers)
+        if failed and (legacy_addrs
+                       or any(w in self._legacy_hit_workers
+                              for w in failed)):
+            uncovered_workers += 1
+        health = self._record_scatter_health(
+            len(workers), len(ok), circuit_open,
+            failovers=len(recovered), dark=dark,
+            uncovered_workers=uncovered_workers)
+        return merged, health
 
     # ---- shard recovery (SURVEY §5.3 — beyond the reference) ----
 
@@ -850,6 +1245,10 @@ class SearchNode:
         stalled leader check here would delay every other client
         event, including the election NodeDeleted that failover
         latency depends on (graftcheck lockgraph finding)."""
+        # membership epoch: scatter batches formed before and after
+        # this transition never share a coalesced group (the batcher's
+        # submit-time group key)
+        self._cluster_epoch += 1
         if self._stopping or not self.config.shard_recovery:
             return
         lost = set(old) - set(new)
@@ -886,12 +1285,23 @@ class SearchNode:
         reappears in the registry (the rejoiner's boot re-walk serves
         whatever was not yet re-placed), and a name only ever enters
         ``_moved`` after its confirmed placement is a DIFFERENT worker —
-        deleting the sole copy is impossible by construction."""
+        deleting the sole copy is impossible by construction. The
+        replication-repair pass that follows a death takes the same
+        serial lock itself, so it runs AFTER this block releases it."""
         with self._reconcile_serial:
             for w in joined:
                 self._reconcile_rejoined(w)
             for w in lost:
                 self._recover_lost_worker(w)
+        if lost and self.config.shard_recovery:
+            # restore R for documents that survived on replicas (runs
+            # outside the block above; repair re-acquires the serial
+            # lock so it can never interleave with a reconcile delete)
+            try:
+                self.run_replication_repair()
+            except Exception as e:
+                log.warning("post-death replication repair failed",
+                            err=repr(e))
 
     def _reconcile_rejoined(self, w: str) -> bool:
         """Delete this rejoiner's moved documents from it (one retried,
@@ -920,23 +1330,21 @@ class SearchNode:
             log.warning("rejoin reconciliation failed", worker=w,
                         err=repr(e))
             return False
-        with self._placement_lock:
-            cur = self._moved.get(w)
-            if cur is not None:
-                cur -= moved   # names moved DURING the RPC stay pending
-                if not cur:
-                    del self._moved[w]
+        # names moved DURING the RPC stay pending
+        self.placement.moved_resolved(w, moved)
         global_metrics.inc("reconciles_completed")
         log.info("reconciled rejoined worker", worker=w,
                  deleted=resp.get("deleted", 0))
         return True
 
     def _reconcile_sweep_loop(self) -> None:
-        """Leader-side periodic retry of failed rejoin reconciles
-        (ADVICE r5 medium: without it a failed /worker/delete leaves
-        moved documents double-indexed until the NEXT membership
-        change). Runs on every node; does work only while leader with
-        pending ``_moved`` entries."""
+        """Leader-side anti-entropy loop: retries failed rejoin
+        reconciles (ADVICE r5 medium: without it a failed
+        /worker/delete leaves moved documents double-indexed until the
+        NEXT membership change) AND repairs the replication factor —
+        re-replicating under-replicated documents after a death,
+        trimming over-replication after a rejoin. Runs on every node;
+        does work only while leader."""
         interval = self.config.reconcile_sweep_interval_s
         while not self._stopping:
             time.sleep(interval)
@@ -950,6 +1358,7 @@ class SearchNode:
                 if not self.is_leader():
                     continue
                 self.run_reconcile_sweep()
+                self.run_replication_repair()
             except Exception as e:
                 log.warning("reconcile sweep pass failed", err=repr(e))
 
@@ -977,18 +1386,26 @@ class SearchNode:
         return done
 
     def _recover_lost_worker(self, w: str) -> None:
-        with self._placement_lock:
-            names = [n for n, holder in self._placement.items()
-                     if holder == w]
-        if not names:
+        """Handle a worker's death. Documents with surviving replicas
+        stay searchable THROUGH the failover scatter path the moment
+        the owner assignment recomputes — they only need their
+        replication factor restored (the repair pass below). Documents
+        whose LAST replica died are re-placed urgently from the durable
+        store, exactly the single-copy recovery of old."""
+        kept, lost = self.placement.drop_worker(w)
+        if not kept and not lost:
             return
-        log.info("re-placing lost worker's shard", worker=w,
-                 docs=len(names))
+        if kept:
+            log.info("worker lost; surviving replicas keep its shard "
+                     "searchable", worker=w, docs=len(kept))
         replaced = 0
         missing = 0
         batch: list[dict] = []
         aborted = False
-        for name in names:
+        if lost:
+            log.info("re-placing lost worker's shard", worker=w,
+                     docs=len(lost))
+        for name in lost:
             if w in self.registry.get_all_service_addresses():
                 # the worker came back mid-recovery: stop — its boot
                 # re-walk serves everything not yet re-placed, and the
@@ -998,9 +1415,19 @@ class SearchNode:
                 break
             data = self._store_read(name)
             if data is None:
-                # placed before this leader's tenure (or its store write
-                # failed) — count and surface: these stay dark until the
-                # pod restarts, exactly the reference's behavior
+                # placed before this leader's tenure (or its store
+                # write failed): the download probe still covers the
+                # promoted-ex-worker case (the new leader's own docs
+                # dir holds the shard it served before its promotion
+                # removed it from the worker pool)
+                try:
+                    data = self.leader_download(name)
+                except Exception:
+                    data = None
+            if data is None:
+                # no byte source anywhere — count and surface: these
+                # stay dark until the pod restarts, exactly the
+                # reference's behavior
                 missing += 1
                 continue
             try:
@@ -1028,22 +1455,15 @@ class SearchNode:
                         "copy; placed before this leader's tenure)",
                         worker=w, unrecovered=missing)
         log.info("shard recovery complete", worker=w, replaced=replaced,
-                 known=len(names), missing=missing, aborted=aborted)
+                 survived=len(kept), known=len(kept) + len(lost),
+                 missing=missing, aborted=aborted)
 
     def _note_moved(self, names: list[str], old_worker: str) -> int:
         """Record names as moved away from ``old_worker`` — only those
-        whose CONFIRMED placement is now a different worker (a doc the
-        upload routed back onto a just-rejoined ``old_worker`` must not
-        be scheduled for deletion from it)."""
-        n = 0
-        with self._placement_lock:
-            moved = self._moved.setdefault(old_worker, set())
-            for name in names:
-                holder = self._placement.get(name)
-                if holder is not None and holder != old_worker:
-                    moved.add(name)
-                    n += 1
-        return n
+        whose CONFIRMED replica set now excludes it (a doc the upload
+        routed back onto a just-rejoined ``old_worker`` must not be
+        scheduled for deletion from it)."""
+        return self.placement.note_moved(names, old_worker)
 
     def _replace_batch(self, docs: list[dict], old_worker: str) -> int:
         try:
@@ -1060,6 +1480,134 @@ class SearchNode:
         return self._note_moved(
             [d["name"] for d in docs if d["name"] not in not_placed],
             old_worker)
+
+    # ---- anti-entropy replication repair ----
+
+    def run_replication_repair(self) -> dict:
+        """One anti-entropy pass (generalizing the reconcile sweep):
+        restore the replication factor for under-replicated documents
+        (new copies from the durable store onto the least-loaded live
+        workers not already holding them) and trim over-replication
+        after rejoins (extras are scheduled for deletion through the
+        same pending-reconcile machinery as moves). Public so tests and
+        operators can force a pass without waiting for the timer.
+
+        Serialized with the reconcile machinery (``_reconcile_serial``,
+        taken here — callers must not hold it): a repair must never
+        re-add a copy to a worker while a reconcile delete for that
+        same name is on the wire, or the delete lands after the re-add
+        and silently erases a mapped replica."""
+        if self._stopping or not self.config.shard_recovery:
+            return {}
+        live = set(self.registry.get_all_service_addresses())
+        if not live:
+            return {}
+        global_injector.check("leader.repair")
+        with self._reconcile_serial:
+            return self._repair_pass(live)
+
+    def _repair_pass(self, live: set[str]) -> dict:
+        """Body of :meth:`run_replication_repair`; caller holds
+        ``_reconcile_serial`` (never the placement lock)."""
+        r = max(1, min(self.config.replication_factor, len(live)))
+        under = self.placement.under_replicated(live, r)
+        added = repaired_missing = 0
+        if under:
+            global_metrics.inc("repair_passes")
+            targets_pool = [w for w in live
+                            if not self.resilience.board.is_open(w)]
+            try:
+                self._ensure_sizes_fresh(targets_pool or sorted(live))
+            except Exception as e:
+                log.warning("repair size poll failed", err=repr(e))
+                return {}
+            with self._placement_lock:
+                sizes = dict(self._size_cache[1])
+            batches: dict[str, list[dict]] = {}
+            files: dict[str, list[tuple[str, bytes]]] = {}
+            for name, reps in sorted(under.items()):
+                data = self._store_read(name)
+                if data is None:
+                    # a NEW leader has no durable store of its own for
+                    # documents placed under a predecessor: fall back to
+                    # the download probe (local engine dir first, then
+                    # the surviving replicas) and cache the bytes so
+                    # future repairs are store-local again
+                    try:
+                        data = self.leader_download(name)
+                    except Exception:
+                        data = None
+                    if data is not None:
+                        self._store_document(name, data)
+                if data is None:
+                    repaired_missing += 1
+                    continue
+                cands = sorted(
+                    (w for w in live
+                     if w not in reps and w in sizes
+                     and not self.resilience.board.is_open(w)),
+                    key=lambda w: (sizes[w], w))
+                for target in cands[:r - len(reps)]:
+                    sizes[target] = sizes.get(target, 0) + len(data)
+                    try:
+                        batches.setdefault(target, []).append(
+                            {"name": name, "text": data.decode("utf-8")})
+                    except UnicodeDecodeError:
+                        files.setdefault(target, []).append((name, data))
+            for target, docs in batches.items():
+                added += self._add_replica_batch(target, docs)
+            for target, items in files.items():
+                for name, data in items:
+                    added += self._add_replica_file(target, name, data)
+            if added:
+                global_metrics.inc("repair_docs_replicated", added)
+        trimmed = self.placement.trim_plan(live, r)
+        n_trim = sum(len(ns) for ns in trimmed.values())
+        if n_trim:
+            # the actual deletes ride the reconcile sweep/rejoin path
+            global_metrics.inc("repair_docs_trimmed", n_trim)
+            log.info("scheduled over-replication trim",
+                     docs=n_trim, workers=len(trimmed))
+        if repaired_missing:
+            global_metrics.inc("repair_docs_unrecoverable",
+                               repaired_missing)
+        return {"replicated": added, "trimmed": n_trim,
+                "missing": repaired_missing}
+
+    def _add_replica_batch(self, target: str, docs: list[dict]) -> int:
+        """Forward one upload-batch of NEW replica copies to ``target``
+        and record the accepted ones in the placement map."""
+        try:
+            resp = json.loads(self.resilience.worker_call(
+                target, lambda: http_post(
+                    target + "/worker/upload-batch",
+                    json.dumps(docs).encode(), timeout=300.0)))
+        except Exception as e:
+            log.warning("replica repair batch failed", worker=target,
+                        docs=len(docs), err=repr(e))
+            return 0
+        skipped = {s["name"] for s in resp.get("skipped", ())}
+        n = 0
+        for d in docs:
+            if d["name"] not in skipped:
+                self.placement.add_replica(d["name"], target)
+                n += 1
+        return n
+
+    def _add_replica_file(self, target: str, name: str,
+                          data: bytes) -> int:
+        q = urllib.parse.quote(name)
+        try:
+            self.resilience.worker_call(
+                target, lambda: http_post(
+                    target + f"/worker/upload?name={q}", data,
+                    content_type="application/octet-stream"))
+        except Exception as e:
+            log.warning("replica repair upload failed", worker=target,
+                        file=name, err=repr(e))
+            return 0
+        self.placement.add_replica(name, target)
+        return 1
 
     # size polls are cached this long; between polls the leader grows
     # its local estimates by the bytes it placed, so bursts still spread
@@ -1120,175 +1668,148 @@ class SearchNode:
                 # cache empty for our workers and 500 a healthy upload
                 self._size_cache = (ts2, {**polled, **cur})
 
-    def _route_name(self, name: str, workers: list[str],
-                    sizes: dict[str, int],
-                    candidates: list[str] | None = None):
-        """Route one document name to a worker. Caller holds
-        ``_placement_lock``. A held name goes to its holder — membership
-        is judged against the REGISTRY list (``workers``), not poll
-        success or breaker state, so a transient size-poll failure or an
-        open breaker cannot re-place an already-placed name on a second
-        worker (duplicate copies double-count in the sum-merge). New
-        names go least-loaded among ``candidates`` (the breaker-filtered
-        subset; defaults to ``workers``) present in ``sizes`` and are
-        tentatively claimed; returns ``(worker, claim_token | None)``."""
-        held = self._placement.get(name)
-        if held in workers:
-            return held, None
-        live = {w: sizes[w] for w in (candidates or workers) if w in sizes}
-        if not live:
-            raise RuntimeError("no reachable workers")
-        chosen = min(live, key=lambda w: (live[w], w))
-        self._placement[name] = chosen
-        token = object()
-        self._claims[name] = token
-        return chosen, token
+    def _leg_succeeded(self, name: str, worker: str,
+                       nbytes: int) -> None:
+        """One upload leg accepted: confirm the placement leg and bump
+        the local size estimate (only for workers already present in
+        the cache: re-inserting an evicted/unpolled worker at near-zero
+        size would defeat the set-mismatch re-poll signal and min-route
+        every new name onto it until TTL expiry)."""
+        self.placement.leg_success(name, worker)
+        with self._placement_lock:
+            sizes = self._size_cache[1]
+            if worker in sizes:
+                sizes[worker] += nbytes
 
-    def _track_inflight(self, name: str) -> None:
-        """Count an upload of ``name`` as in flight (caller holds
-        ``_placement_lock``); settled by ``_settle_success`` /
-        ``_settle_failure``."""
-        self._inflight[name] = self._inflight.get(name, 0) + 1
-
-    def _dec_inflight(self, name: str) -> int:
-        n = self._inflight.get(name, 1) - 1
-        if n > 0:
-            self._inflight[name] = n
-        else:
-            self._inflight.pop(name, None)
-        return n
-
-    def _settle_success(self, name: str, worker: str,
-                        nbytes: int) -> None:
-        """Record a worker-ACCEPTED placement. Caller holds
-        ``_placement_lock``. Clears ANY pending claim for the name —
-        the placement is confirmed now, so a failed sibling upload must
-        not release it. The size estimate is bumped only for workers
-        already present in the cache: re-inserting an evicted/unpolled
-        worker at near-zero size would defeat the set-mismatch re-poll
-        signal and min-route every new name onto it until TTL expiry."""
-        self._dec_inflight(name)
-        self._claims.pop(name, None)
-        self._placement[name] = worker
-        sizes = self._size_cache[1]
-        if worker in sizes:
-            sizes[worker] += nbytes
-
-    def _settle_failure(self, name: str, token, worker: str) -> None:
-        """Undo a tentative claim after a failed forward. Caller holds
-        ``_placement_lock``. Guards, in order:
-
-        * while a sibling upload of the name is still in flight, leave
-          everything in place — the sibling may yet confirm this very
-          placement, and deleting the entry under it would let a third
-          upload re-place the name on a different worker (duplicate
-          copies, double-counted in the sum-merge);
-        * once the LAST in-flight upload settles, a still-present claim
-          means the placement was never confirmed by any worker — drop
-          both, whether this caller held the claim token (it created
-          the claim) or followed it as a held route (``token=None``;
-          the claimer failed earlier while this one was in flight).
-          Without the held-route branch a phantom placement survives:
-          every retry of the name stays pinned to a worker that never
-          accepted it;
-        * identity-compare a non-None token — a newer claim created
-          after this upload launched is not ours to delete."""
-        remaining = self._dec_inflight(name)
-        if remaining > 0:
-            return
-        tok = self._claims.get(name)
-        if tok is None:
-            return   # placement (if any) was confirmed by a success
-        if token is not None and token is not tok:
-            return   # a newer claim exists; not ours to delete
-        del self._claims[name]
-        if self._placement.get(name) == worker:
-            del self._placement[name]
+    def _leg_failed(self, name: str, worker: str,
+                    app_reject: bool) -> None:
+        """One upload leg failed: release the never-confirmed tentative
+        replica (phantom cleanup lives in the placement map) and, for
+        transport failures, evict the worker from the size cache so the
+        next upload re-polls at once instead of re-choosing the dead
+        worker until TTL expiry. A 4xx is an APPLICATION rejection from
+        a healthy worker — no eviction, or interleaved bad uploads
+        would force a full serial re-poll before every good one."""
+        self.placement.leg_failure(name, worker)
+        if not app_reject:
+            with self._placement_lock:
+                self._size_cache[1].pop(worker, None)
+                self._evicted[worker] = time.monotonic()
 
     def leader_upload(self, filename: str, data: bytes) -> dict:
-        """Least-loaded placement (``Leader.java:153-207``) with two
-        framework improvements over the reference's per-upload loop:
+        """R-way least-loaded placement (generalizing
+        ``Leader.java:153-207``):
 
         * worker index sizes are polled at most once per TTL (the
           reference polls every worker for every file,
           ``Leader.java:170-179`` — O(workers) HTTP round trips per
           document kills bulk ingest);
-        * a name seen before routes to the worker already holding it,
-          so a re-upload UPSERTS the one existing copy instead of
-          placing a duplicate on the currently-smallest worker (which
-          would double-count the name in the scatter-gather sum-merge).
-          The map is per-leader-tenure; a name placed under a previous
-          leader may still duplicate — the reference has no dedup at
-          all.
-        """
+        * a NEW name fans out to ``replication_factor`` distinct
+          least-loaded workers (capped by the live worker count); a
+          name seen before routes to the workers already holding it,
+          so a re-upload UPSERTS every existing copy instead of
+          placing duplicates (which would diverge replicas and
+          double-count in a naive merge). The map is durable through
+          the coordination substrate, so holders survive leader
+          failover.
+
+        The upload succeeds when AT LEAST ONE replica accepted (the
+        document is searchable); a failed leg's tentative replica is
+        released and the anti-entropy repair loop restores the
+        replication factor from the durable store."""
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
         # route NEW names away from workers with open breakers (held
-        # names still go to their holder — single-copy beats liveness);
-        # if every breaker is open, fall through and let the call fail
-        # honestly rather than refuse on possibly-stale breaker state
+        # names still go to their holders — replica continuity beats
+        # liveness); if every breaker is open, fall through and let the
+        # call fail honestly rather than refuse on stale breaker state
         route_workers = [w for w in workers
                          if not self.resilience.board.is_open(w)] or workers
         with self._placement_lock:
-            held = self._placement.get(filename)
-            if held in workers:
-                chosen = held
-                self._track_inflight(filename)
-            else:
-                chosen = None
-        token = None
-        if chosen is None:
+            held = tuple(w for w in self.placement.replicas.get(
+                filename, ()) if w in workers)
+        if not held:
             self._ensure_sizes_fresh(route_workers)  # polls off the lock
-            with self._placement_lock:
-                chosen, token = self._route_name(
-                    filename, workers, self._size_cache[1], route_workers)
-                self._track_inflight(filename)
+        with self._placement_lock:
+            replicas, _new = self.placement.route_locked(
+                filename, workers, self._size_cache[1], route_workers,
+                self.config.replication_factor)
         q = urllib.parse.quote(filename)
-        try:
+
+        def send(w: str):
             # retried (bounded) on transient transport failures: the
             # worker-side ingest is an idempotent upsert by name, so a
             # double-applied attempt converges to the same index state
-            self.resilience.worker_call(
-                chosen, lambda: http_post(
-                    chosen + f"/worker/upload?name={q}", data,
+            return self.resilience.worker_call(
+                w, lambda w=w: http_post(
+                    w + f"/worker/upload?name={q}", data,
                     content_type="application/octet-stream"))
-        except BaseException as e:
-            # a 4xx is an APPLICATION rejection (e.g. 415 on binary
-            # formats) from a healthy worker — don't evict it from the
-            # size cache, or interleaved bad uploads force a full
-            # serial re-poll before every good one
-            app_reject = (isinstance(e, urllib.error.HTTPError)
-                          and e.code < 500)
-            with self._placement_lock:
-                self._settle_failure(filename, token, chosen)
-                # evict the unreachable worker from the size cache: the
-                # set-mismatch forces the next upload to re-poll at once
-                # instead of re-choosing the dead worker until TTL expiry
-                if not app_reject:
-                    self._size_cache[1].pop(chosen, None)
-                    self._evicted[chosen] = time.monotonic()
-            raise
-        # size/placement state is confirmed only AFTER the worker accepted
-        with self._placement_lock:
-            self._settle_success(filename, chosen, len(data))
-            sizes = dict(self._size_cache[1])
+
+        futs = {self._pool.submit(send, w): w for w in replicas}
+        confirmed: list[str] = []
+        errors: dict[str, BaseException] = {}
+        for fut, w in futs.items():
+            try:
+                try:
+                    # bounded: ~attempts x the 30s http timeout + backoff
+                    fut.result(timeout=120.0)
+                except FutureTimeout:
+                    # the shared pool may have QUEUED this leg behind
+                    # slow scatters — only a cancelled (never-started)
+                    # leg is truly failed; a running one is bounded by
+                    # its own RPC timeouts and must be awaited, or a
+                    # worker that eventually ACCEPTED the copy would be
+                    # recorded as not holding it (unmapped duplicate =
+                    # double count)
+                    if fut.cancel():
+                        raise
+                    fut.result(timeout=900.0)
+            except BaseException as e:
+                errors[w] = e
+                self._leg_failed(
+                    filename, w,
+                    app_reject=(isinstance(e, urllib.error.HTTPError)
+                                and e.code < 500))
+                continue
+            confirmed.append(w)
+            self._leg_succeeded(filename, w, len(data))
+        if not confirmed:
+            # every replica failed: propagate one error (an application
+            # rejection — e.g. 415 — wins so the handler's status
+            # mapping stays intact; all replicas see the same bytes)
+            for e in errors.values():
+                if isinstance(e, urllib.error.HTTPError) and e.code < 500:
+                    raise e
+            raise next(iter(errors.values()))
+        if len(confirmed) < len(replicas):
+            global_metrics.inc("uploads_partially_replicated")
         if self.config.shard_recovery:
             self._store_document(filename, data)
         global_metrics.inc("uploads_placed")
+        with self._placement_lock:
+            sizes = dict(self._size_cache[1])
         # the worker may be absent from the size cache (held-route after
         # an eviction skips the freshness poll) — never KeyError a
         # SUCCESSFUL upload on a logging detail
-        log.info("upload placed", file=filename, worker=chosen,
-                 size=sizes.get(chosen, -1))
-        return {"worker": chosen, "sizes": sizes}
+        log.info("upload placed", file=filename, workers=confirmed,
+                 size=sizes.get(confirmed[0], -1))
+        return {"worker": confirmed[0], "replicas": confirmed,
+                "sizes": sizes}
 
     def leader_upload_batch(self, docs: list[dict]) -> dict:
         """Bulk ingest (framework addition — the reference only places
-        one file per request): place each named document with the same
-        cached least-loaded policy, then forward ONE ``upload-batch``
-        request per worker. Payloads are JSON ``{"name", "text"}``
-        (text documents; binary uploads use the per-file endpoint)."""
+        one file per request): place each named document on its
+        ``replication_factor`` least-loaded workers with the same
+        cached policy as the per-file path, then forward ONE
+        ``upload-batch`` request per worker (a document appears in R
+        workers' groups). Payloads are JSON ``{"name", "text"}`` (text
+        documents; binary uploads use the per-file endpoint).
+
+        ``placed`` counts per-worker ACCEPTED copies; ``failed`` lists
+        names no worker confirmed (transport-errored on every replica
+        leg) — a partially-replicated name is placed (searchable) and
+        the repair loop restores its missing copies later."""
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
@@ -1296,23 +1817,22 @@ class SearchNode:
         route_workers = [w for w in workers
                          if not self.resilience.board.is_open(w)] or workers
         # validate BEFORE any tracking: a KeyError mid-planning-loop
-        # would leak inflight counts + claims for docs already routed,
-        # pinning those names to never-confirmed placements forever
+        # would leak in-flight legs for docs already routed, pinning
+        # those names to never-confirmed placements forever
         for d in docs:
             if not isinstance(d, dict) or not isinstance(
                     d.get("name"), str) or not d["name"]:
                 raise ValueError("every document needs a string 'name'")
             if not isinstance(d.get("text", ""), str):
                 raise ValueError("document 'text' must be a string")
-        # plan the split with a local estimate; size-cache confirmations
-        # happen only for groups a worker ACCEPTED — a failed forward
+        # plan the split with a local estimate; placement confirmations
+        # happen only for copies a worker ACCEPTED — a failed forward
         # must not leave the leader believing the unreachable worker
-        # holds documents it never received. New names are tentatively
-        # claimed (token-identified) under the lock so a concurrent
-        # upload of the same name routes to the same worker.
+        # holds documents it never received. New names claim their R
+        # replicas under the lock so a concurrent upload of the same
+        # name routes to the same workers.
         self._ensure_sizes_fresh(route_workers)   # polls outside the lock
         per_worker: dict[str, list[dict]] = {}
-        claimed: dict[str, dict[str, object]] = {}   # w -> {name: token}
         with self._placement_lock:
             # plan against a local estimate so the batch itself spreads
             # by projected size; claims/placements go through the same
@@ -1321,73 +1841,88 @@ class SearchNode:
                    if w in self._size_cache[1]}
             for d in docs:
                 name = d["name"]
-                w, token = self._route_name(name, workers, est,
-                                            route_workers)
-                if token is not None:
-                    claimed.setdefault(w, {})[name] = token
-                self._track_inflight(name)
-                per_worker.setdefault(w, []).append(d)
-                # bump only workers already in the estimate: a held name
-                # routed to an unpolled worker must not inject it at
-                # near-zero size, or every later NEW name in the batch
-                # would min-route onto the possibly-unreachable worker
-                if w in est:
-                    est[w] += len(d.get("text", ""))
+                reps, _new = self.placement.route_locked(
+                    name, workers, est, route_workers,
+                    self.config.replication_factor)
+                for w in reps:
+                    per_worker.setdefault(w, []).append(d)
+                    # bump only workers already in the estimate: a held
+                    # name routed to an unpolled worker must not inject
+                    # it at near-zero size, or every later NEW name in
+                    # the batch would min-route onto the
+                    # possibly-unreachable worker
+                    if w in est:
+                        est[w] += len(d.get("text", ""))
+
+        def forward(w: str, group: list[dict]) -> dict:
+            # bounded transient retry; worker-side ingest is an
+            # idempotent upsert by name (see leader_upload)
+            return json.loads(self.resilience.worker_call(
+                w, lambda: http_post(
+                    w + "/worker/upload-batch",
+                    json.dumps(group).encode(), timeout=300.0)))
+
+        futs = {self._pool.submit(forward, w, group): (w, group)
+                for w, group in per_worker.items()}
         placed = {}
         errors = {}
-        skipped: list[dict] = []
-        failed: list[str] = []   # names in transport-errored groups
-        for w, group in per_worker.items():
+        skipped_by_name: dict[str, dict] = {}
+        confirmed_names: set[str] = set()
+        for fut, (w, group) in futs.items():
             try:
-                # bounded transient retry; worker-side ingest is an
-                # idempotent upsert by name (see leader_upload)
-                resp = json.loads(self.resilience.worker_call(
-                    w, lambda w=w, group=group: http_post(
-                        w + "/worker/upload-batch",
-                        json.dumps(group).encode(), timeout=300.0)))
+                try:
+                    # bounded: ~attempts x the 300s http timeout
+                    resp = fut.result(timeout=1200.0)
+                except FutureTimeout:
+                    # same queued-vs-running distinction as the
+                    # per-file path: never fail a leg that may still
+                    # land on the worker
+                    if fut.cancel():
+                        raise
+                    resp = fut.result(timeout=1200.0)
             except Exception as e:
                 errors[w] = repr(e)
-                failed.extend(d["name"] for d in group)
                 app_reject = (isinstance(e, urllib.error.HTTPError)
                               and e.code < 500)
-                with self._placement_lock:
-                    w_claims = claimed.get(w, {})
-                    for d in group:   # settle EVERY name, claimed or held
-                        self._settle_failure(
-                            d["name"], w_claims.get(d["name"]), w)
-                    if not app_reject:      # fast re-poll on transport
-                        self._size_cache[1].pop(w, None)   # failures only
+                for d in group:   # settle EVERY leg, claimed or held
+                    self.placement.leg_failure(d["name"], w)
+                if not app_reject:      # fast re-poll on transport
+                    with self._placement_lock:   # failures only
+                        self._size_cache[1].pop(w, None)
                         self._evicted[w] = time.monotonic()
                 continue
             # the worker reports per-doc UnsupportedMediaType skips —
             # those names were NOT indexed and must not enter the
             # placement map or the placed counts
             w_skipped = {s["name"] for s in resp.get("skipped", ())}
-            skipped.extend(resp.get("skipped", ()))
+            for s in resp.get("skipped", ()):
+                skipped_by_name.setdefault(s["name"], s)
             placed[w] = len(group) - len(w_skipped)
-            with self._placement_lock:
-                for d in group:
-                    name = d["name"]
-                    if name in w_skipped:
-                        self._settle_failure(
-                            name, claimed.get(w, {}).get(name), w)
-                        continue
-                    self._settle_success(name, w,
-                                         len(d.get("text", "")))
-            if self.config.shard_recovery:
-                for d in group:
-                    if d["name"] not in w_skipped:
-                        self._store_document(
-                            d["name"], d.get("text", "").encode("utf-8"))
-            global_metrics.inc("uploads_placed", placed[w])
+            for d in group:
+                name = d["name"]
+                if name in w_skipped:
+                    self.placement.leg_failure(name, w)
+                    continue
+                self._leg_succeeded(name, w, len(d.get("text", "")))
+                confirmed_names.add(name)
+        if self.config.shard_recovery:
+            for d in docs:
+                if d["name"] in confirmed_names:
+                    self._store_document(
+                        d["name"], d.get("text", "").encode("utf-8"))
+        global_metrics.inc("uploads_placed", len(confirmed_names))
         if errors and not placed:
             raise RuntimeError(f"all workers failed: {errors}")
         out = {"placed": placed}
-        if skipped:
-            out["skipped"] = skipped
+        if skipped_by_name:
+            out["skipped"] = list(skipped_by_name.values())
         if errors:
             out["errors"] = errors
-            out["failed"] = failed
+            # names no replica confirmed and no worker skipped: never
+            # indexed anywhere
+            out["failed"] = [d["name"] for d in docs
+                             if d["name"] not in confirmed_names
+                             and d["name"] not in skipped_by_name]
         return out
 
     def leader_download_stream(self, rel: str):
@@ -1494,6 +2029,29 @@ class _NodeHandler(BaseHTTPRequestHandler):
             return _parse_multipart(body, ctype)
         return self._query_param(u, "name"), body
 
+    def _deadline_header(self) -> float | None:
+        """``X-Deadline-Ms`` (the leader's remaining scatter budget) as
+        a local monotonic deadline; None when absent or malformed."""
+        dl = self.headers.get("X-Deadline-Ms")
+        if dl is None:
+            return None
+        try:
+            return time.monotonic() + float(dl) / 1e3
+        except ValueError:
+            return None
+
+    def _past_deadline(self) -> bool:
+        """Refuse (504 + ``X-Deadline-Exceeded``) when the propagated
+        budget is already spent; True when the reply was sent."""
+        d = self._deadline_header()
+        if d is not None and time.monotonic() > d:
+            global_metrics.inc("worker_deadline_refusals")
+            self._send(504, b"deadline exceeded",
+                       "text/plain; charset=utf-8",
+                       headers={"X-Deadline-Exceeded": "1"})
+            return True
+        return False
+
     def _read_query(self) -> str:
         """The search query: accept raw text (the reference POSTs the bare
         query string, ``Leader.java:54-59``) or ``{"query": ...}`` JSON."""
@@ -1561,6 +2119,13 @@ class _NodeHandler(BaseHTTPRequestHandler):
         node = self.node
         try:
             if u.path == "/worker/process":
+                # same deadline refusal as the batched endpoint: the
+                # leader's per-query path propagates X-Deadline-Ms too,
+                # and scoring for a caller that already gave up burns
+                # device time nobody merges. External reference clients
+                # never send the header — parity behavior is untouched.
+                if self._past_deadline():
+                    return
                 global_injector.check("worker.process")
                 query = self._read_query()
                 try:
@@ -1575,14 +2140,39 @@ class _NodeHandler(BaseHTTPRequestHandler):
             elif u.path == "/worker/process-batch":
                 # batched scatter RPC (leader-internal; packed reply —
                 # see cluster/wire.py). The per-query endpoint above
-                # keeps the reference-compatible JSON shape.
+                # keeps the reference-compatible JSON shape. With
+                # "names" the request is an ownership SLICE (failover /
+                # hedged re-issue): score only those documents, exact
+                # within the slice.
                 global_injector.check("worker.process")
+                # propagated scatter budget: the leader's remaining
+                # milliseconds at dispatch; a batch whose budget is
+                # already gone is refused with a 504 the resilience
+                # layer treats as non-retryable — scoring it would
+                # burn device time nobody will merge (the deadline is
+                # re-checked after the NRT commit in
+                # _search_batch_guarded)
+                if self._past_deadline():
+                    return
+                deadline = self._deadline_header()
                 req = json.loads(self._body().decode("utf-8"))
                 queries = [str(q) for q in req.get("queries", ())]
                 k = req.get("k")
+                names = req.get("names")
                 try:
-                    body = node.worker_search_batch_wire(
-                        queries, k=int(k) if k is not None else None)
+                    if names is not None:
+                        body = pack_hit_lists(node.worker_search_slice(
+                            queries, [str(n) for n in names],
+                            deadline=deadline))
+                    else:
+                        body = node.worker_search_batch_wire(
+                            queries, k=int(k) if k is not None else None,
+                            deadline=deadline)
+                except WorkerDeadline as e:
+                    self._send(504, f"{e}".encode(),
+                               "text/plain; charset=utf-8",
+                               headers={"X-Deadline-Exceeded": "1"})
+                    return
                 except Exception as e:
                     # honest failure propagation (ADVICE r5): an engine
                     # failure must surface as a 5xx the leader counts in
@@ -1672,7 +2262,13 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 if health.get("degraded"):
                     hdrs = {"X-Scatter-Degraded":
                             "attempted={attempted} responded={responded} "
-                            "circuit_open={circuit_open}".format(**health)}
+                            "circuit_open={circuit_open} "
+                            "failovers={failovers} dark={dark}"
+                            .format(failovers=health.get("failovers", 0),
+                                    dark=health.get("dark", 0), **{
+                                        k: health[k] for k in
+                                        ("attempted", "responded",
+                                         "circuit_open")})}
                 self._json(result, headers=hdrs)
             elif u.path == "/leader/upload":
                 name, data = self._read_upload(u)

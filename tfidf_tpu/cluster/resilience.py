@@ -33,6 +33,7 @@ import socket
 import threading
 import time
 import urllib.error
+from concurrent.futures import wait as _futures_wait
 from typing import Callable
 
 from tfidf_tpu.utils.faults import FaultInjected, global_injector
@@ -46,17 +47,35 @@ class RpcStatusError(RuntimeError):
     """A worker answered with a non-2xx status. Carrying the status as
     data (instead of string-matching ``repr``) lets the retry classifier
     distinguish gateway-transient statuses (retryable) from application
-    rejections and deterministic server failures (not)."""
+    rejections and deterministic server failures (not).
 
-    def __init__(self, url: str, status: int) -> None:
-        super().__init__(f"{url} -> {status}")
+    ``deadline_exceeded`` marks a 504 that is a DEADLINE refusal — the
+    worker (or the leader's own pre-dispatch check) declining to start
+    work whose caller budget is already spent. Unlike a gateway 504 it
+    is never retried (the budget cannot come back) and never indicts
+    the worker (refusing honestly is healthy behavior)."""
+
+    def __init__(self, url: str, status: int,
+                 deadline_exceeded: bool = False) -> None:
+        super().__init__(f"{url} -> {status}"
+                         + (" (deadline exceeded)" if deadline_exceeded
+                            else ""))
         self.url = url
         self.status = status
+        self.deadline_exceeded = deadline_exceeded
 
 
 class CircuitOpenError(RuntimeError):
     """Fast-fail: the target worker's breaker is open (or its single
     half-open probe slot is taken). No RPC was attempted."""
+
+
+class DeadlineExpired(RuntimeError):
+    """The caller's budget ran out BEFORE dispatch — no RPC was made.
+    Never retried, and (unlike a worker's 504 deadline refusal, which
+    proves the worker alive) it carries NO evidence about the target:
+    ``worker_call`` releases the breaker without recording success or
+    failure."""
 
 
 # connection-level failures: the peer is unreachable or the socket died.
@@ -89,9 +108,13 @@ def is_retryable(e: BaseException) -> bool:
     the retry path."""
     if isinstance(e, socket.timeout):   # subclass of OSError — check first
         return False
+    if isinstance(e, DeadlineExpired):
+        return False   # the budget cannot come back
     if isinstance(e, FaultInjected):
         return True
     if isinstance(e, RpcStatusError):
+        if e.deadline_exceeded:
+            return False   # the caller's budget is spent; honest failure
         return e.status in _TRANSIENT_STATUSES
     if isinstance(e, urllib.error.HTTPError):
         return e.code in _TRANSIENT_STATUSES
@@ -108,6 +131,8 @@ def is_worker_fault(e: BaseException) -> bool:
     trip its breaker; everything else — connection failures, timeouts,
     5xx — does."""
     if isinstance(e, RpcStatusError):
+        if e.deadline_exceeded:
+            return False   # honest refusal from a healthy worker
         return e.status >= 500
     if isinstance(e, urllib.error.HTTPError):
         return e.code >= 500
@@ -166,6 +191,37 @@ class RetryPolicy:
                 global_injector.check("resilience.backoff")
                 self._sleep(delay)
         raise AssertionError("unreachable")   # loop always returns/raises
+
+
+def hedge_laggards(futures: dict, delay_s: float, on_laggard) -> set:
+    """Hedged-read primitive ("The Tail at Scale", Dean & Barroso 2013):
+    wait up to ``delay_s`` for the futures in ``futures`` (future ->
+    tag); for each one still outstanding at the deadline invoke
+    ``on_laggard(tag)`` exactly once and return the set of laggard tags.
+
+    The primitive only DETECTS the laggards — the caller decides what a
+    hedge is (the leader re-issues the laggard's ownership slice to the
+    next replica) and owns merging/deduping the duplicate results.
+    ``on_laggard`` runs on the calling thread and must dispatch async
+    work rather than block; a raising callback is counted
+    (``hedge_dispatch_failures``) and swallowed so one bad hedge cannot
+    take down the primary gather it exists to protect."""
+    if delay_s <= 0 or not futures:
+        return set()
+    _done, pending = _futures_wait(set(futures), timeout=delay_s)
+    laggards = set()
+    for fut in pending:
+        tag = futures[fut]
+        laggards.add(tag)
+        try:
+            on_laggard(tag)
+        except Exception as e:
+            global_metrics.inc("hedge_dispatch_failures")
+            log.warning("hedge dispatch failed", target=str(tag),
+                        err=repr(e))
+    if laggards:
+        global_metrics.inc("hedges_dispatched", len(laggards))
+    return laggards
 
 
 # breaker states
@@ -247,6 +303,14 @@ class CircuitBreaker:
         if closed:
             global_metrics.inc("breaker_closed")
             log.info("circuit breaker closed", target=self.name)
+
+    def release(self) -> None:
+        """Outcome unknown (no RPC was attempted, e.g. the caller's
+        budget expired pre-dispatch): free the half-open probe slot
+        without recording evidence either way — a breaker must never
+        CLOSE on a worker that was not contacted."""
+        with self._lock:
+            self._probe_inflight = False
 
     def record_failure(self) -> None:
         with self._lock:
@@ -356,7 +420,9 @@ class ClusterResilience:
         try:
             out = self.policy.call(fn) if retry else fn()
         except Exception as e:
-            if is_worker_fault(e):
+            if isinstance(e, DeadlineExpired):
+                b.release()   # never dispatched: no evidence either way
+            elif is_worker_fault(e):
                 b.record_failure()
             else:
                 b.record_success()   # a 4xx proves the worker is alive

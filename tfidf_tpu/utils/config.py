@@ -205,9 +205,34 @@ class Config:
     # of the registry, re-places that worker's documents onto survivors
     # so the full corpus stays searchable. When the dead worker rejoins
     # (same URL), the leader reconciles by deleting the moved documents
-    # from it. Scope: documents placed during the current leader's
-    # tenure (a freshly promoted leader starts with an empty store).
+    # from it. Byte recovery covers documents placed during the current
+    # leader's tenure; replica OWNERSHIP survives failover through the
+    # durable placement map below.
     shard_recovery: bool = True
+
+    # --- replication (R-way placement + failover scatter reads) ---
+    # Every uploaded document is placed on this many distinct
+    # least-loaded workers (capped by the live worker count). Each
+    # scatter assigns exactly ONE live, breaker-closed replica to score
+    # each document (the sum-merge stays double-count-free by
+    # construction); when that owner fails mid-request the leader
+    # re-issues only the orphaned ownership slice to a surviving
+    # replica WITHIN the same request, so single-worker death loses no
+    # documents. 1 = the pre-replication single-copy behavior
+    # (reference parity).
+    replication_factor: int = 2
+    # Hedged duplicate reads (The Tail at Scale): a worker that has not
+    # answered its scatter RPC after this many milliseconds gets its
+    # ownership slice speculatively re-issued to the next replica; the
+    # merge dedups by owner epoch (the primary's reply wins if it
+    # lands). 0 disables hedging.
+    scatter_hedge_ms: float = 0.0
+    # Debounce for persisting the leader's placement map (doc ->
+    # replica set, plus pending-reconcile state) as znodes through the
+    # coordination substrate, so a NEW leader resumes with exact
+    # ownership instead of an empty in-memory map. Negative disables
+    # persistence (per-tenure map only).
+    placement_flush_ms: float = 50.0
 
     # --- coordination durability + quorum (cluster/wal.py, ensemble.py) ---
     # Empty data dir = in-memory substrate (the pre-durability behavior).

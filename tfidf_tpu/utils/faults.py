@@ -31,6 +31,14 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
     "leader.size_poll": "leader polling one worker's /worker/index-size",
     "leader.reconcile_rpc": "leader's /worker/delete rejoin-reconcile RPC",
     "leader.sweep": "one reconciliation-sweep pass on the leader",
+    "leader.replica_rpc": "leader re-issuing an orphaned ownership slice "
+                          "to a surviving replica (failover scatter read)",
+    "leader.hedge": "leader dispatching a hedged duplicate read for a "
+                    "laggard worker's ownership slice",
+    "leader.repair": "one anti-entropy replication-repair pass on the "
+                     "leader (restore R / trim over-replication)",
+    "leader.placement_persist": "leader persisting the placement map to "
+                                "the coordination substrate",
     "worker.process": "worker handling /worker/process[-batch]",
     "worker.upload": "worker handling /worker/upload[-batch]",
     "coord.heartbeat.*": "coordination server receiving a session "

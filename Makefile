@@ -19,6 +19,13 @@
 #                     single-node oracle; plus SIGKILL of the whole
 #                     coordinator ensemble with the placement map
 #                     intact (tests/test_replication.py -m slow)
+#   make chaos-rebalance  slow elastic-data-plane chaos job: kill -9
+#                     the migration SOURCE at leader.rebalance_copy and
+#                     the TARGET at leader.rebalance_flip mid-drain,
+#                     plus a hard leader kill mid-migration, all under
+#                     a concurrent search workload asserting exact
+#                     single-node-oracle merge parity on every response
+#                     (tests/test_rebalance.py -m slow)
 #   make faults       list every registered fault point (chaos configs
 #                     should be validated against this — see
 #                     utils/faults.py)
@@ -40,8 +47,8 @@
 
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test chaos chaos-coord chaos-replica faults bench probe-overlap \
-        graftcheck lockdep check
+.PHONY: test chaos chaos-coord chaos-replica chaos-rebalance faults \
+        bench probe-overlap graftcheck lockdep check
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -59,7 +66,8 @@ graftcheck:
 lockdep:
 	JAX_PLATFORMS=cpu GRAFTCHECK_LOCKDEP=1 python -m pytest \
 	  tests/test_resilience.py tests/test_cluster.py \
-	  tests/test_replication.py tests/test_graftcheck.py \
+	  tests/test_replication.py tests/test_rebalance.py \
+	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 check: graftcheck test
@@ -72,6 +80,9 @@ chaos-coord:
 
 chaos-replica:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_replication.py $(PYTEST_FLAGS) -m slow
+
+chaos-rebalance:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_rebalance.py $(PYTEST_FLAGS) -m slow
 
 faults:
 	python -m tfidf_tpu faults list

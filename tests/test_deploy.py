@@ -21,8 +21,9 @@ def test_k8s_manifest_structure():
     with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
     kinds = sorted(d["kind"] for d in docs)
-    assert kinds == ["Deployment", "Namespace", "Service", "Service",
-                     "Service", "StatefulSet"]
+    assert kinds == ["Deployment", "HorizontalPodAutoscaler",
+                     "Namespace", "Service", "Service", "Service",
+                     "StatefulSet"]
     deployments = {d["metadata"]["name"]: d for d in docs
                    if d["kind"] == "Deployment"}
     assert set(deployments) == {"tfidf-node"}
@@ -94,6 +95,50 @@ def test_k8s_coordinator_ensemble():
     mounts = {m["name"]: m["mountPath"]
               for m in pod["containers"][0]["volumeMounts"]}
     assert mounts["data"] == "/data"
+
+
+def test_k8s_hpa_autoscaling():
+    """The worker autoscaling story (ROADMAP item 1's HPA pairing):
+    the search-node Deployment scales on the serving-pressure gauges
+    /api/metrics already emits, and every metric the HPA keys on must
+    correspond to a gauge actually emitted somewhere in the tree —
+    a renamed gauge must fail here, not silently stop scaling."""
+    with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    hpas = [d for d in docs if d["kind"] == "HorizontalPodAutoscaler"]
+    assert len(hpas) == 1
+    spec = hpas[0]["spec"]
+    ref = spec["scaleTargetRef"]
+    assert ref["kind"] == "Deployment" and ref["name"] == "tfidf-node"
+    # the HPA floor matches the Deployment's replica count
+    node = next(d for d in docs if d["kind"] == "Deployment")
+    assert spec["minReplicas"] == node["spec"]["replicas"]
+    assert spec["maxReplicas"] > spec["minReplicas"]
+
+    names = {m["pods"]["metric"]["name"] for m in spec["metrics"]
+             if m["type"] == "Pods"}
+    assert names == {"tfidf_last_scatter_queue_depth",
+                     "tfidf_index_size_bytes"}
+    # each adapter-exported series (tfidf_<gauge>) maps to a gauge the
+    # code emits: index_size_bytes is a literal set_gauge name, the
+    # queue-depth gauge is the coalescer's f"last_{name}_queue_depth"
+    # with the scatter batcher named "scatter"
+    src = ""
+    pkg = os.path.join(ROOT, "tfidf_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    src += f.read()
+    assert '"index_size_bytes"' in src
+    assert '_queue_depth"' in src
+    assert 'name="scatter"' in src
+
+    # graceful scale-down: a long stabilization window so operators can
+    # drain workers before pods disappear
+    assert spec["behavior"]["scaleDown"][
+        "stabilizationWindowSeconds"] >= 300
 
 
 def test_dockerfile_structure():

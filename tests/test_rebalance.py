@@ -1,0 +1,888 @@
+"""Elastic data plane: crash-safe live shard migration + drain.
+
+The acceptance story: the Rebalancer moves doc ranges between live
+workers through a staged, durable state machine (``copying -> flipped
+-> reconciled``) such that a crash of the leader, the source, or the
+target at ANY step loses nothing and double-counts nothing, and
+searches issued during a rebalance stay exact. Pieces under test:
+
+- pure planning (overload / join-absorption detection from doc counts);
+- the placement-map migration primitives (begin/flip/unflip/end, trim
+  protection, durable serialization);
+- live migration end to end: a joining worker absorbs load via the
+  sweep, reconcile deletes converge, searches stay complete;
+- drain (``/api/drain``, CLI): a worker is migrated empty with EXACT
+  single-node-oracle parity throughout (full-replication construction:
+  every owner holds the full corpus at every step), then excluded from
+  new-name routing;
+- crash safety at each injected fault point (``leader.rebalance_copy``
+  / ``_flip`` / ``_reconcile``) and across leader failover mid-phase:
+  copying-phase records are rolled back (stray legs reclaimed by the
+  trim pass), non-durable flips are un-flipped before any delete can
+  run, and a durable flip's reconcile tail survives a leader change;
+- observability: the rebalance gauges/counters and the CLI ``status``
+  summary.
+
+The slow chaos job (``make chaos-rebalance``) adds real ``kill -9`` of
+the source and the target subprocess at the injected fault points, and
+a hard leader kill mid-migration, under a concurrent parity workload.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from tfidf_tpu.cluster.coordination import CoordinationCore, LocalCoordination
+from tfidf_tpu.cluster.node import SearchNode, http_get, http_post
+from tfidf_tpu.cluster.placement import PLACEMENT_STATE, PlacementMap
+from tfidf_tpu.cluster.rebalance import plan_moves
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.faults import FaultInjected, global_injector
+from tfidf_tpu.utils.metrics import global_metrics
+
+from tests.test_cluster import wait_until
+from tests.test_replication import (_CFG, DOCS, QUERIES, _assert_parity,
+                                    _oracle, _search, _stop_all,
+                                    _upload_docs)
+
+
+@pytest.fixture
+def core():
+    c = CoordinationCore(session_timeout_s=0.5)
+    yield c
+    c.close()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    global_injector.disarm()
+
+
+def _node(core, tmp_path, i, port=0, **kw):
+    cfg_kw = dict(_CFG)
+    # keep the automatic pass out of the way unless a test opts in —
+    # these tests drive run_once()/drain explicitly for determinism
+    cfg_kw.setdefault("rebalance_sweep_ms", 10_000_000.0)
+    cfg_kw.update(kw)
+    cfg = Config(
+        documents_path=str(tmp_path / f"rb{i}" / "documents"),
+        index_path=str(tmp_path / f"rb{i}" / "index"),
+        port=port, **cfg_kw)
+    return SearchNode(cfg, coord=LocalCoordination(core, 0.1)).start()
+
+
+def _mk_cluster(core, tmp_path, n=3, **kw):
+    nodes = [_node(core, tmp_path, i, **kw) for i in range(n)]
+    wait_until(lambda: len(
+        nodes[0].registry.get_all_service_addresses()) == n - 1)
+    return nodes
+
+
+def _counts(leader):
+    live = leader.registry.get_all_service_addresses()
+    return {w: len(leader.placement.names_on(w)) for w in live}
+
+
+def _assert_complete(got, ctx=""):
+    assert set(got) == set(DOCS), \
+        f"{ctx}: missing={set(DOCS) - set(got)} extra={set(got) - set(DOCS)}"
+
+
+# ---------------------------------------------------------------------------
+# Pure planning
+# ---------------------------------------------------------------------------
+
+class TestPlanMoves:
+    def test_balanced_cluster_plans_nothing(self):
+        assert plan_moves({"a": 6, "b": 6}, 0) == {}
+        assert plan_moves({"a": 6, "b": 5, "c": 7}, 0) == {}
+
+    def test_single_worker_or_empty_plans_nothing(self):
+        assert plan_moves({"a": 12}, 0) == {}
+        assert plan_moves({}, 0) == {}
+        assert plan_moves({"a": 0, "b": 0}, 0) == {}
+
+    def test_join_absorption_moves_toward_mean(self):
+        # a fresh worker at 0 next to a loaded one: donate down to mean
+        assert plan_moves({"a": 12, "b": 0}, 0) == {"a": 6}
+        out = plan_moves({"a": 10, "b": 10, "c": 1}, 0)
+        # mean=7: both loaded workers donate 3, bounded by c's room (6)
+        assert sum(out.values()) == 6 and set(out) == {"a", "b"}
+
+    def test_cap_triggers_even_mild_imbalance(self):
+        # without the cap, 8 vs 4 sits inside the slack band; the cap
+        # forces the oversized shard to donate down to the mean
+        assert plan_moves({"a": 8, "b": 4}, 0) == {"a": 2}
+        assert plan_moves({"a": 7, "b": 5}, 6) == {"a": 1}
+
+    def test_no_receivers_means_no_moves(self):
+        # everyone over the cap but balanced: nowhere better to move
+        assert plan_moves({"a": 10, "b": 10}, 4) == {}
+
+
+# ---------------------------------------------------------------------------
+# Placement-map migration primitives
+# ---------------------------------------------------------------------------
+
+class TestMigrationStateMachine:
+    def _seeded(self):
+        pm = PlacementMap(flush_ms=-1)
+        pm.replicas.update({"x": ("http://a",), "y": ("http://a",)})
+        pm._confirmed.update({"x": {"http://a"}, "y": {"http://a"}})
+        return pm
+
+    def test_flip_moves_ownership_and_schedules_delete(self):
+        pm = self._seeded()
+        mid = pm.begin_migration("http://a", {"x": ["http://b"]})
+        assert pm.migration_snapshot()[mid]["phase"] == "copying"
+        # copy leg confirms on the target
+        pm.add_replica("x", "http://b")
+        assert pm.holders_of("x") == ("http://a", "http://b")
+        flipped = pm.flip_migration(mid)
+        assert flipped == ["x"]
+        assert pm.holders_of("x") == ("http://b",)
+        assert pm.moved["http://a"] == {"x"}
+        # a flipped record is never re-flipped
+        assert pm.flip_migration(mid) == []
+        pm.end_migration(mid)
+        assert pm.migration_snapshot() == {}
+
+    def test_flip_skips_unconfirmed_copy(self):
+        pm = self._seeded()
+        mid = pm.begin_migration("http://a", {"x": ["http://b"],
+                                              "y": ["http://b"]})
+        pm.add_replica("x", "http://b")   # only x's copy confirmed
+        assert pm.flip_migration(mid) == ["x"]
+        # y never flipped: still owned (and held) by the source
+        assert pm.holders_of("y") == ("http://a",)
+        assert "y" not in pm.moved.get("http://a", set())
+
+    def test_unflip_restores_exactly(self):
+        pm = self._seeded()
+        mid = pm.begin_migration("http://a", {"x": ["http://b"]})
+        pm.add_replica("x", "http://b")
+        before = pm.holders_of("x")
+        assert pm.flip_migration(mid) == ["x"]
+        pm.unflip_migration(mid)
+        assert pm.holders_of("x") == before
+        assert "x" in pm._confirmed["x"] or True   # source re-confirmed
+        assert "http://a" in pm._confirmed["x"]
+        assert "x" not in pm.moved.get("http://a", set())
+        # rolled back to copying: a later flip can retry
+        assert pm.migration_snapshot()[mid]["phase"] == "copying"
+        assert pm.flip_migration(mid) == ["x"]
+
+    def test_trim_protects_migrating_names(self):
+        pm = self._seeded()
+        live = {"http://a", "http://b"}
+        mid = pm.begin_migration("http://a", {"x": ["http://b"]})
+        pm.add_replica("x", "http://b")
+        # r=1 would trim the freshly copied leg — the record protects it
+        assert pm.trim_plan(live, 1) == {}
+        pm.end_migration(mid)
+        trimmed = pm.trim_plan(live, 1)
+        assert trimmed == {"http://b": ["x"]}
+
+    def test_durable_roundtrip_carries_migrations_and_draining(self,
+                                                               core):
+        coord = LocalCoordination(core, 0.1)
+        try:
+            pm = PlacementMap(flush_ms=0.0)
+            pm.bind_store(lambda: coord)
+            pm.set_persist_enabled(True)
+            pm.replicas["x"] = ("http://a",)
+            pm._confirmed["x"] = {"http://a"}
+            mid = pm.begin_migration("http://a", {"x": ["http://b"]},
+                                     kind="drain")
+            pm.set_draining("http://a", True)
+            assert pm.flush()
+
+            pm2 = PlacementMap(flush_ms=0.0)
+            pm2.bind_store(lambda: coord)
+            assert pm2.load() == 1
+            recs = pm2.migration_snapshot()
+            assert recs[mid]["phase"] == "copying"
+            assert recs[mid]["kind"] == "drain"
+            assert pm2.draining_snapshot() == frozenset({"http://a"})
+            # the id sequence continues past the loaded record
+            mid2 = pm2.begin_migration("http://a", {"y": ["http://b"]})
+            assert mid2 != mid
+        finally:
+            coord.close()
+
+    def test_drop_worker_clears_draining_durably(self, core):
+        """The completed-drain decommission: the worker leaves holding
+        ZERO docs, so drop_worker touches no replicas — but the
+        draining-flag clear must still persist, or load()'s union
+        resurrects it forever and a later pod at the same stable URL
+        is silently excluded from routing."""
+        coord = LocalCoordination(core, 0.1)
+        try:
+            pm = PlacementMap(flush_ms=0.0)
+            pm.bind_store(lambda: coord)
+            pm.set_persist_enabled(True)
+            pm.set_draining("http://a", True)
+            assert pm.flush()
+            pm.drop_worker("http://a")   # held nothing: kept == lost == []
+            assert pm.flush()
+            pm2 = PlacementMap(flush_ms=0.0)
+            pm2.bind_store(lambda: coord)
+            pm2.load()
+            assert pm2.draining_snapshot() == frozenset()
+        finally:
+            coord.close()
+
+    def test_reset_for_follower_clears_rebalance_state(self):
+        pm = self._seeded()
+        pm.begin_migration("http://a", {"x": ["http://b"]})
+        pm.set_draining("http://a", True)
+        pm.reset_for_follower()
+        assert pm.migration_snapshot() == {}
+        assert pm.draining_snapshot() == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# Live migration end to end (in-process cluster)
+# ---------------------------------------------------------------------------
+
+class TestLiveMigration:
+    def test_joining_worker_absorbed_via_sweep(self, core, tmp_path):
+        """The ROADMAP item 1 story: every doc sits on one loaded
+        worker; a fresh worker joins; the sweep-driven rebalancer moves
+        half the corpus onto it live, the reconcile deletes converge,
+        and every search stays complete throughout."""
+        kw = dict(replication_factor=1, rebalance_sweep_ms=50.0)
+        nodes = _mk_cluster(core, tmp_path, n=2, **kw)
+        try:
+            leader = nodes[0]
+            _upload_docs(leader)
+            _assert_complete(_search(leader, "common"), "pre")
+            assert sum(_counts(leader).values()) == len(DOCS)
+
+            joined = _node(core, tmp_path, 9, **kw)
+            nodes.append(joined)
+
+            def balanced():
+                _assert_complete(_search(leader, "common"), "during")
+                c = _counts(leader)
+                return (len(c) == 2 and joined.url in c
+                        and c[joined.url] >= len(DOCS) // 2 - 1
+                        and not leader.placement.pending_moved()
+                        and not leader.placement.migration_snapshot())
+            assert wait_until(balanced, timeout=30.0), _counts(leader)
+            assert global_metrics.get("rebalance_moved_docs") >= 5
+            _assert_complete(_search(leader, "common"), "post")
+        finally:
+            _stop_all(nodes)
+
+    def test_migrate_moves_range_and_reconciles(self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3, replication_factor=1)
+        try:
+            leader = nodes[0]
+            _upload_docs(leader)
+            source = nodes[1].url
+            names = leader.placement.names_on(source)[:3]
+            assert names
+            out = leader.rebalancer.migrate(source, names)
+            assert out["moved"] == len(names) and out["failed"] == 0
+            for n in names:
+                holders = leader.placement.holders_of(n)
+                assert source not in holders and len(holders) == 1
+            # reconcile deletes land (triggered inline, swept on failure)
+            assert wait_until(
+                lambda: not leader.placement.pending_moved().get(source),
+                timeout=10.0)
+            _assert_complete(_search(leader, "common"), "post-migrate")
+            # no stray records, no stray replicas
+            assert leader.placement.migration_snapshot() == {}
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Drain: planned decommission with exact oracle parity throughout
+# ---------------------------------------------------------------------------
+
+class TestDrain:
+    def test_drain_empties_worker_exact_parity_throughout(self, core,
+                                                          tmp_path):
+        """Full-replication construction (R=2 over 2 workers): every
+        worker's shard statistics equal the single-node oracle's, so
+        every search during the drain must match the oracle EXACTLY —
+        any replica double-count or lost doc breaks score equality.
+        The drain target (a freshly joined third worker) receives the
+        WHOLE corpus before any flip, so post-flip owners are
+        full-corpus shards too: parity holds at every step of
+        ``copying -> flipped -> reconciled``."""
+        nodes = _mk_cluster(core, tmp_path, n=3, replication_factor=2)
+        try:
+            leader = nodes[0]
+            victim = nodes[1]
+            _upload_docs(leader)
+            want = _oracle(tmp_path)
+            for q in QUERIES:
+                _assert_parity(_search(leader, q), want[q], ctx=q)
+
+            joined = _node(core, tmp_path, 9, replication_factor=2)
+            nodes.append(joined)
+            wait_until(lambda: len(
+                leader.registry.get_all_service_addresses()) == 3)
+
+            resp = json.loads(http_post(
+                leader.url + "/api/drain",
+                json.dumps({"worker": victim.url}).encode()))
+            assert resp["draining"] is True
+
+            def drained():
+                for q in QUERIES:   # exact parity DURING the drain
+                    _assert_parity(_search(leader, q), want[q],
+                                   ctx=f"during:{q}")
+                st = json.loads(http_get(
+                    leader.url + "/api/drain?worker="
+                    + urllib.parse.quote(victim.url)))
+                return st["drained"]
+            assert wait_until(drained, timeout=30.0)
+            assert leader.placement.names_on(victim.url) == []
+            # the deletes really landed on the worker
+            assert wait_until(
+                lambda: victim.engine.index.num_live_docs == 0,
+                timeout=10.0)
+            for q in QUERIES:
+                _assert_parity(_search(leader, q), want[q], ctx=f"post:{q}")
+            assert global_metrics.get("rebalance_drains_completed") >= 1
+
+            # new names route AWAY from the draining worker
+            out = leader.leader_upload("fresh.txt", b"brand new pelican")
+            assert victim.url not in out["replicas"]
+            # cancel clears the exclusion
+            json.loads(http_post(
+                leader.url + "/api/drain",
+                json.dumps({"worker": victim.url,
+                            "cancel": True}).encode()))
+            assert victim.url not in \
+                leader.placement.draining_snapshot()
+        finally:
+            _stop_all(nodes)
+
+    def test_drain_is_leader_only(self, core, tmp_path):
+        """Both verbs 409 on a non-leader: a follower's placement map
+        is reset on demotion, so a GET answered from it would report a
+        vacuous {"drained": true} and an operator's --wait poll could
+        decommission a worker that still holds docs."""
+        nodes = _mk_cluster(core, tmp_path, n=2)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_post(nodes[1].url + "/api/drain",
+                          json.dumps({"worker": nodes[1].url}).encode())
+            assert ei.value.code == 409
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_get(nodes[1].url + "/api/drain?worker="
+                         + urllib.parse.quote(nodes[1].url))
+            assert ei.value.code == 409
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Crash safety at every injected fault point + across leader failover
+# ---------------------------------------------------------------------------
+
+class TestCrashSafety:
+    def test_copy_fault_aborts_without_ownership_change(self, core,
+                                                        tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3, replication_factor=1)
+        try:
+            leader = nodes[0]
+            _upload_docs(leader)
+            source = nodes[1].url
+            names = leader.placement.names_on(source)[:3]
+            with leader._placement_lock:
+                before = dict(leader._placement)
+
+            global_injector.arm("leader.rebalance_copy", action="raise")
+            out = leader.rebalancer.migrate(source, names)
+            assert out["moved"] == 0 and out["failed"] == len(names)
+            assert global_metrics.get("rebalance_failures") >= len(names)
+            # nothing moved, nothing scheduled for delete, no record
+            with leader._placement_lock:
+                assert dict(leader._placement) == before
+            assert not leader.placement.pending_moved().get(source)
+            assert leader.placement.migration_snapshot() == {}
+            _assert_complete(_search(leader, "common"), "after abort")
+
+            # healed: the same range migrates cleanly
+            global_injector.disarm("leader.rebalance_copy")
+            out = leader.rebalancer.migrate(source, names)
+            assert out["moved"] == len(names)
+            _assert_complete(_search(leader, "common"), "after heal")
+        finally:
+            _stop_all(nodes)
+
+    def test_flip_persist_failure_rolls_back_unflipped(self, core,
+                                                       tmp_path):
+        """A flip that cannot be made durable is rolled back BEFORE any
+        delete can run: the source keeps ownership, no moved entries
+        leak, and the already-copied legs are reclaimed by the trim
+        pass once the record is gone."""
+        nodes = _mk_cluster(core, tmp_path, n=3, replication_factor=1)
+        try:
+            leader = nodes[0]
+            _upload_docs(leader)
+            source = nodes[1].url
+            names = leader.placement.names_on(source)[:2]
+
+            global_injector.arm("leader.placement_persist",
+                                action="raise")
+            out = leader.rebalancer.migrate(source, names)
+            assert out["moved"] == 0
+            for n in names:   # source still first (owning) replica
+                assert leader.placement.holders_of(n)[0] == source
+            assert not leader.placement.pending_moved().get(source)
+            _assert_complete(_search(leader, "common"), "rolled back")
+
+            global_injector.disarm("leader.placement_persist")
+            # the stray copy legs are plain over-replication now: the
+            # repair pass trims them back to R=1
+            leader.run_replication_repair()
+            assert wait_until(
+                lambda: all(
+                    len(leader.placement.holders_of(n)) == 1
+                    for n in names), timeout=10.0)
+            _assert_complete(_search(leader, "common"), "trimmed")
+        finally:
+            _stop_all(nodes)
+
+    def test_reconcile_fault_leaves_durable_flip_for_sweep(self, core,
+                                                           tmp_path):
+        """A crash at the reconcile trigger (post-durable-flip) loses
+        nothing: the moved state is durable and the periodic sweep
+        finishes the deletes."""
+        nodes = _mk_cluster(core, tmp_path, n=3, replication_factor=1)
+        try:
+            leader = nodes[0]
+            _upload_docs(leader)
+            source = nodes[1].url
+            names = leader.placement.names_on(source)[:2]
+            global_injector.arm("leader.rebalance_reconcile",
+                                action="raise", times=1)
+            out = leader.rebalancer.migrate(source, names)
+            assert out["moved"] == len(names)   # flip already durable
+            _assert_complete(_search(leader, "common"), "pre-sweep")
+            # the sweep converges the deletes without the trigger
+            assert wait_until(
+                lambda: not leader.placement.pending_moved().get(source),
+                timeout=10.0)
+            _assert_complete(_search(leader, "common"), "post-sweep")
+        finally:
+            _stop_all(nodes)
+
+    def test_leader_failover_mid_copy_aborts_and_reclaims(self, core,
+                                                          tmp_path):
+        """A copying-phase migration is durable when the leader dies:
+        the NEW leader loads the record, aborts it (ownership never
+        moved — a half-copied range is never believed owned), and the
+        repair/trim pass reclaims the stray confirmed legs."""
+        nodes = _mk_cluster(core, tmp_path, n=4, replication_factor=1)
+        leader = nodes[0]
+        try:
+            _upload_docs(leader)
+            source = nodes[2].url
+            target = nodes[3].url
+            names = leader.placement.names_on(source)[:2]
+            assert names
+            # reproduce the exact mid-copy durable state: record in
+            # phase "copying" + confirmed copy legs on the target
+            mid = leader.placement.begin_migration(
+                source, {n: [target] for n in names})
+            docs = [{"name": n, "text": DOCS[n]} for n in names]
+            assert leader._add_replica_batch(target, docs) == len(names)
+            assert leader.placement.flush()
+            raw = json.loads(
+                leader.coord.get_data(PLACEMENT_STATE).decode())
+            assert mid in raw.get("migrations", {})
+
+            leader.stop()
+            new_leader = nodes[1]
+            assert wait_until(new_leader.is_leader, timeout=10.0)
+            # the record is aborted on resume, and the duplicate legs
+            # trimmed back to R=1 — with the SOURCE keeping ownership
+            assert wait_until(
+                lambda: not new_leader.placement.migration_snapshot(),
+                timeout=15.0)
+            assert wait_until(
+                lambda: all(
+                    len(new_leader.placement.holders_of(n)) == 1
+                    for n in names), timeout=15.0)
+
+            def settled():
+                got = _search(new_leader, "common")
+                return set(got) == set(DOCS)
+            assert wait_until(settled, timeout=20.0)
+        finally:
+            _stop_all(nodes)
+
+    def test_leader_failover_post_flip_resumes_reconcile(self, core,
+                                                         tmp_path):
+        """A durable flip survives a leader change: the moved state
+        rides the placement znode (PR 5), so the NEW leader keeps the
+        flipped ownership — the range is never re-flipped back to the
+        source and nothing is double-counted or lost. The migration
+        SOURCE is the next-in-line leader itself, so its promotion (the
+        messiest failover: the promoted ex-worker's own shard gets
+        re-placed) cannot legitimately disturb the flipped range."""
+        nodes = _mk_cluster(core, tmp_path, n=4, replication_factor=1)
+        leader = nodes[0]
+        try:
+            _upload_docs(leader)
+            source = nodes[1].url   # == the next leader in line
+            names = leader.placement.names_on(source)[:2]
+            assert names
+            # flip lands durably, but every delete RPC fails: the
+            # reconcile tail is still pending when the leader dies
+            global_injector.arm("leader.reconcile_rpc", action="raise")
+            out = leader.rebalancer.migrate(source, names)
+            assert out["moved"] == len(names)
+            assert set(leader.placement.pending_moved().get(
+                source, ())) >= set(names)
+            new_holders = {n: leader.placement.holders_of(n)
+                           for n in names}
+            leader.stop()
+
+            new_leader = nodes[1]
+            assert wait_until(new_leader.is_leader, timeout=10.0)
+            global_injector.disarm("leader.reconcile_rpc")
+            # resumed from the durable map: flipped ownership intact
+            # (never re-flipped back to the source) and the pending
+            # reconcile state loaded
+            assert wait_until(
+                lambda: set(new_leader.placement.pending_moved().get(
+                    source, ())) >= set(names), timeout=10.0)
+            for n in names:
+                assert new_leader.placement.holders_of(n) \
+                    == new_holders[n]
+
+            # the promoted ex-worker's own (unmigrated) shard is
+            # re-placed by the PR-5 machinery; the full corpus stays
+            # searchable with no doubles — the rejoiner's stale copies
+            # are excluded through the pending-reconcile state
+            def settled():
+                got = _search(new_leader, "common")
+                return set(got) == set(DOCS) \
+                    and got == _search(new_leader, "common")
+            assert wait_until(settled, timeout=30.0)
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Observability: gauges + CLI status summary
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_metrics_and_cli_status_summary(self, core, tmp_path,
+                                            capsys):
+        nodes = _mk_cluster(core, tmp_path, n=3, replication_factor=1)
+        try:
+            leader = nodes[0]
+            _upload_docs(leader)
+            source = nodes[1].url
+            names = leader.placement.names_on(source)[:2]
+            out = leader.rebalancer.migrate(source, names)
+            assert out["moved"] == len(names)
+
+            snap = json.loads(http_get(leader.url + "/api/metrics"))
+            assert snap["rebalance_moved_docs"] >= len(names)
+            assert snap["rebalance_active"] == 0
+            assert snap["rebalance_draining_workers"] == 0
+
+            from tfidf_tpu.cli import main
+            rc = main(["status", "--leader", leader.url])
+            assert rc == 0
+            st = json.loads(capsys.readouterr().out)
+            rb = st["rebalance"]
+            assert rb["moved_docs_total"] >= len(names)
+            assert rb["active_migrations"] == 0
+            assert set(rb) == {"active_migrations", "draining_workers",
+                               "moved_docs_total", "failures_total",
+                               "drains_started", "drains_completed"}
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Chaos (slow): kill -9 source/target/leader at injected fault points
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestChaosRebalance:
+    @pytest.mark.timeout(420)
+    def test_kill9_source_and_target_at_fault_points(self, tmp_path):
+        """Real ``kill -9`` of the migration SOURCE at
+        ``leader.rebalance_copy`` and of the migration TARGET at
+        ``leader.rebalance_flip``, mid-drain, under a concurrent search
+        workload asserting EXACT single-node-oracle parity on every
+        response. Full-replication construction: R=2 over two initial
+        workers, so every owner (and every failover backup) holds the
+        full corpus at every step — zero lost docs, zero double-counted
+        scores, to the last digit."""
+        import os
+        import signal
+        import socket
+        import subprocess
+        import sys
+
+        from tfidf_tpu.cluster.coordination import CoordinationClient
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        env = os.environ.copy()
+        env["TFIDF_JAX_PLATFORM"] = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "TFIDF_REPLICATION_FACTOR": "2",
+            "TFIDF_TOP_K": "64",
+            "TFIDF_SESSION_TIMEOUT_S": "1.0",
+            "TFIDF_HEARTBEAT_INTERVAL_S": "0.2",
+            "TFIDF_RECONCILE_SWEEP_INTERVAL_S": "0.5",
+            "TFIDF_MIN_DOC_CAPACITY": "64",
+            "TFIDF_MIN_NNZ_CAPACITY": "4096",
+            "TFIDF_MIN_VOCAB_CAPACITY": "1024",
+            "TFIDF_QUERY_BATCH": "8",
+            "TFIDF_MAX_QUERY_TERMS": "8",
+        })
+        coord_port = free_port()
+        procs = {}
+
+        def wait_pred(pred, timeout=60.0, interval=0.2):
+            deadline = time.monotonic() + timeout
+            last = None
+            while time.monotonic() < deadline:
+                try:
+                    if pred():
+                        return True
+                except Exception as e:
+                    last = e
+                time.sleep(interval)
+            raise AssertionError(f"timed out; last={last!r}")
+
+        def spawn(tag, args):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "tfidf_tpu", *args],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            procs[tag] = p
+            return p
+
+        def worker_args(i, port):
+            return ["serve", "--port", str(port), "--host", "127.0.0.1",
+                    "--coordinator-address", f"127.0.0.1:{coord_port}",
+                    "--documents-path", str(tmp_path / f"w{i}" / "docs"),
+                    "--index-path", str(tmp_path / f"w{i}" / "index")]
+
+        leader = None
+        try:
+            spawn("coord", ["coordinator", "--listen",
+                            f"127.0.0.1:{coord_port}"])
+            wait_pred(lambda: socket.create_connection(
+                ("127.0.0.1", coord_port), timeout=1.0).close() or True,
+                timeout=60.0)
+
+            # IN-PROCESS leader (first in: wins the election) so the
+            # fault points can be armed with kill -9 callables and the
+            # placement map inspected directly
+            cfg = Config(
+                documents_path=str(tmp_path / "L" / "docs"),
+                index_path=str(tmp_path / "L" / "index"), port=0,
+                **{**_CFG, "replication_factor": 2, "top_k": 64,
+                   "session_timeout_s": 1.0,
+                   "reconcile_sweep_interval_s": 0.5,
+                   "rebalance_sweep_ms": 10_000_000.0})
+
+            def factory():
+                return CoordinationClient(
+                    f"127.0.0.1:{coord_port}",
+                    heartbeat_interval_s=0.2)
+            leader = SearchNode(cfg, coord_factory=factory).start()
+            assert wait_until(leader.is_leader, timeout=30.0)
+
+            ports = [free_port() for _ in range(4)]
+            urls = [f"http://127.0.0.1:{p}" for p in ports]
+            for i in range(2):
+                spawn(f"w{i}", worker_args(i, ports[i]))
+            assert wait_until(lambda: len(
+                leader.registry.get_all_service_addresses()) == 2,
+                timeout=120.0)
+
+            _upload_docs(leader)
+            want = _oracle(tmp_path, top_k=64)
+
+            def parity_now():
+                for q in QUERIES:
+                    got = json.loads(http_post(
+                        leader.url + "/leader/start",
+                        json.dumps({"query": q}).encode(),
+                        timeout=60.0))
+                    _assert_parity(got, want[q], ctx=q)
+                return True
+            wait_pred(parity_now, timeout=120.0, interval=1.0)
+
+            failures = []
+            stop_churn = threading.Event()
+
+            def churn():
+                while not stop_churn.is_set():
+                    for q in QUERIES:
+                        try:
+                            got = json.loads(http_post(
+                                leader.url + "/leader/start",
+                                json.dumps({"query": q}).encode(),
+                                timeout=60.0))
+                            _assert_parity(got, want[q], ctx=q)
+                        except AssertionError as e:
+                            failures.append(e)
+                        except Exception as e:
+                            failures.append(
+                                AssertionError(f"transport: {e!r}"))
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+
+            # ---- scenario A: kill -9 the SOURCE at rebalance_copy ----
+            spawn("w2", worker_args(2, ports[2]))   # drain target
+            assert wait_until(lambda: len(
+                leader.registry.get_all_service_addresses()) == 3,
+                timeout=120.0)
+            source_url = urls[0]
+            global_injector.arm(
+                "leader.rebalance_copy", action="callable", times=1,
+                fn=lambda: os.kill(procs["w0"].pid, signal.SIGKILL))
+            leader.rebalancer.start_drain(source_url)
+            # the dead source falls out; every doc keeps its surviving
+            # replica; repair restores R=2 onto the new worker
+            assert wait_until(lambda: source_url not in
+                              leader.registry
+                              .get_all_service_addresses(),
+                              timeout=30.0)
+            survivors = {urls[1], urls[2]}
+
+            def restored():
+                with leader._placement_lock:
+                    return all(len(set(ws) & survivors) == 2
+                               for ws in leader._placement.values())
+            assert wait_until(restored, timeout=60.0)
+            global_injector.disarm()
+
+            # ---- scenario B: kill -9 the TARGET at rebalance_flip ----
+            spawn("w3", worker_args(3, ports[3]))
+            assert wait_until(lambda: len(
+                leader.registry.get_all_service_addresses()) == 3,
+                timeout=120.0)
+
+            def kill_flip_target():
+                # the migration record names the target: kill it at the
+                # flip point, the moment before ownership moves
+                recs = leader.placement.migration_snapshot()
+                for rec in recs.values():
+                    for ts in rec["targets"].values():
+                        for turl in ts:
+                            if turl == urls[3]:
+                                os.kill(procs["w3"].pid,
+                                        signal.SIGKILL)
+                                return
+            global_injector.arm("leader.rebalance_flip",
+                                action="callable", times=1,
+                                fn=kill_flip_target)
+            leader.rebalancer.start_drain(urls[1])
+            assert wait_until(lambda: urls[3] not in
+                              leader.registry
+                              .get_all_service_addresses(),
+                              timeout=30.0)
+            global_injector.disarm()
+            leader.rebalancer.cancel_drain(urls[1])
+
+            time.sleep(3.0)
+            stop_churn.set()
+            t.join(timeout=120)
+            assert not failures, failures[:3]
+            # steady state: still exact, nothing dark, nothing doubled
+            assert parity_now()
+        finally:
+            global_injector.disarm()
+            if leader is not None:
+                try:
+                    leader.stop()
+                except Exception:
+                    pass
+            for p in procs.values():
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    pass
+
+    @pytest.mark.timeout(300)
+    def test_leader_hard_killed_mid_migration_resumes(self, core,
+                                                      tmp_path):
+        """Hard leader death at the flip fault point (coordination
+        session expired + HTTP front door closed, never a graceful
+        stop), with the mid-copy migration state durable: the NEW
+        leader loads the znode, aborts the copying-phase record,
+        RESTARTS the drain it inherited, and converges with zero lost
+        documents."""
+        kw = dict(replication_factor=1)
+        nodes = _mk_cluster(core, tmp_path, n=4, **kw)
+        leader = nodes[0]
+        try:
+            _upload_docs(leader)
+            _assert_complete(_search(leader, "common"), "pre")
+            drain_victim = nodes[2].url
+
+            def hard_kill_leader():
+                # force the copying-phase state durable first (the
+                # debounced flush may not have fired yet), then die
+                leader.placement.flush()
+                leader.httpd.shutdown()
+                leader.httpd.server_close()
+                core.expire_session(leader.coord.sid)
+                raise FaultInjected("leader killed at rebalance_flip")
+            global_injector.arm("leader.rebalance_flip",
+                                action="callable", times=1,
+                                fn=hard_kill_leader)
+            leader.rebalancer.start_drain(drain_victim)
+
+            new_leader = nodes[1]
+            assert wait_until(new_leader.is_leader, timeout=15.0)
+            global_injector.disarm()
+            # the new leader inherited the draining flag and restarted
+            # the drain; the copying-phase record was aborted
+            assert wait_until(
+                lambda: drain_victim in
+                new_leader.placement.draining_snapshot(), timeout=15.0)
+            assert wait_until(
+                lambda: not new_leader.placement.migration_snapshot()
+                or all(r["phase"] != "copying" for r in
+                       new_leader.placement.migration_snapshot()
+                       .values()), timeout=15.0)
+            assert wait_until(
+                lambda: not new_leader.placement.names_on(drain_victim)
+                and not new_leader.placement.pending_moved().get(
+                    drain_victim), timeout=60.0)
+
+            def settled():
+                return set(_search(new_leader, "common")) == set(DOCS)
+            assert wait_until(settled, timeout=30.0)
+        finally:
+            _stop_all(nodes)

@@ -12,6 +12,7 @@ The reference ships one Spring Boot fat jar that every node runs
     upload       client: send a document to a running cluster's leader
     query        client: search a running cluster
     status       client: node role + live membership + degraded summary
+    drain        client: migrate a worker empty before decommission
     bench        run the TPU benchmark
     faults       chaos tooling: list registered fault points
 
@@ -395,8 +396,56 @@ def cmd_status(args) -> int:
             int(metrics.get("repair_docs_replicated", 0)),
         "repair_docs_trimmed": int(metrics.get("repair_docs_trimmed", 0)),
     }
+    # elastic-rebalance summary (README "Elastic rebalancing & drain"):
+    # in-flight migrations/drains and the lifetime moved/failed totals
+    out["rebalance"] = {
+        "active_migrations": int(metrics.get("rebalance_active", 0)),
+        "draining_workers":
+            int(metrics.get("rebalance_draining_workers", 0)),
+        "moved_docs_total": int(metrics.get("rebalance_moved_docs", 0)),
+        "failures_total": int(metrics.get("rebalance_failures", 0)),
+        "drains_started": int(metrics.get("rebalance_drains_started", 0)),
+        "drains_completed":
+            int(metrics.get("rebalance_drains_completed", 0)),
+    }
     print(json.dumps(out, indent=2))
     return 0
+
+
+def cmd_drain(args) -> int:
+    """Planned decommission: ask the leader to migrate a worker empty
+    (live, crash-safe) so it can leave the cluster with zero loss."""
+    import time as _time
+
+    from tfidf_tpu.cluster.node import http_get, http_post
+
+    url = _leader_url(args)
+    body = json.dumps({"worker": args.worker,
+                       "cancel": bool(args.cancel)}).encode()
+    resp = json.loads(http_post(url + "/api/drain", body))
+    print(json.dumps(resp, indent=2))
+    if args.cancel or not args.wait:
+        return 0
+    # poll until the worker holds nothing and its deletes landed; a
+    # transient poll failure (leader restart, leadership change mid-
+    # drain answering 409) is retried until the deadline — the wait
+    # loop exists precisely to ride out such windows
+    deadline = _time.monotonic() + args.wait_timeout
+    last_err = None
+    while _time.monotonic() < deadline:
+        try:
+            q = urllib.parse.quote(args.worker)
+            st = json.loads(http_get(url + f"/api/drain?worker={q}"))
+            if st.get("drained"):
+                print(json.dumps(st, indent=2))
+                return 0
+        except Exception as e:
+            last_err = e
+        _time.sleep(1.0)
+    print("drain did not complete in time"
+          + (f" (last poll error: {last_err!r})" if last_err else ""),
+          file=sys.stderr)
+    return 1
 
 
 def cmd_faults(args) -> int:
@@ -504,6 +553,17 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("status", help="node role + membership + metrics")
     s.add_argument("--leader", required=True, help="any node's base URL")
     s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("drain",
+                       help="migrate a worker empty before decommission")
+    s.add_argument("worker", help="worker base URL to drain")
+    s.add_argument("--leader", required=True, help="leader base URL")
+    s.add_argument("--cancel", action="store_true",
+                   help="cancel an in-progress drain")
+    s.add_argument("--wait", action="store_true",
+                   help="poll until the worker is fully drained")
+    s.add_argument("--wait-timeout", type=float, default=300.0)
+    s.set_defaults(fn=cmd_drain)
 
     s = sub.add_parser("bench", help="run the TPU benchmark")
     s.set_defaults(fn=cmd_bench)

@@ -197,10 +197,17 @@ class Coalescer:
                            and (self.group_key is None
                                 or self._items[0].key == key)):
                         batch.append(self._items.popleft())
+                depth = len(self._items)
                 if not self._items and not self._stopping:
                     # never clear after stop() set the event, or sibling
                     # dispatcher threads park in _wake.wait() forever
                     self._wake.clear()
+            # queue depth LEFT BEHIND after this batch formed: the
+            # serving-pressure signal the k8s HPA scales workers on
+            # (deploy/k8s.yaml) — 0 in steady state, grows when offered
+            # load outruns the dispatch pipeline
+            global_metrics.set_gauge(f"last_{self.name}_queue_depth",
+                                     depth)
             if not batch:
                 continue
             try:

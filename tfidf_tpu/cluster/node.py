@@ -51,6 +51,7 @@ from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
                                     unpack_hit_lists)
 from tfidf_tpu.cluster.election import LeaderElection
 from tfidf_tpu.cluster.placement import PlacementMap
+from tfidf_tpu.cluster.rebalance import Rebalancer
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
 from tfidf_tpu.cluster.resilience import (CircuitOpenError,
                                           ClusterResilience,
@@ -311,6 +312,9 @@ class SearchNode:
         # Reconciles run one at a time (_reconcile_serial) so a rejoin
         # cannot interleave with an in-flight recovery.
         self._reconcile_serial = threading.Lock()
+        # elastic data plane: live shard migration / drain, riding the
+        # sweep loop below (cluster/rebalance.py)
+        self.rebalancer = Rebalancer(self)
         # membership epoch: scatter batches group by the value at
         # SUBMIT time, so one coalesced batch never spans a membership
         # transition (one batch = one owner assignment's world view)
@@ -731,6 +735,10 @@ class SearchNode:
         try:
             if not self.is_leader():
                 return
+            # resolve a predecessor's in-flight migrations FIRST (abort
+            # copying-phase records) so the repair/trim pass below can
+            # reclaim their stray copy legs in the same sweep
+            self.rebalancer.resume_after_election()
             live = set(self.registry.get_all_service_addresses())
             with self._placement_lock:
                 known = {w for ws in self._placement.values()
@@ -1359,6 +1367,9 @@ class SearchNode:
                     continue
                 self.run_reconcile_sweep()
                 self.run_replication_repair()
+                # elastic rebalance rides the same leader-side loop,
+                # self-paced by rebalance_sweep_ms
+                self.rebalancer.maybe_run()
             except Exception as e:
                 log.warning("reconcile sweep pass failed", err=repr(e))
 
@@ -1512,10 +1523,14 @@ class SearchNode:
         r = max(1, min(self.config.replication_factor, len(live)))
         under = self.placement.under_replicated(live, r)
         added = repaired_missing = 0
+        draining = self.placement.draining_snapshot()
         if under:
             global_metrics.inc("repair_passes")
+            # never repair ONTO a draining worker — its drain would just
+            # migrate the fresh copy straight back off
             targets_pool = [w for w in live
-                            if not self.resilience.board.is_open(w)]
+                            if not self.resilience.board.is_open(w)
+                            and w not in draining]
             try:
                 self._ensure_sizes_fresh(targets_pool or sorted(live))
             except Exception as e:
@@ -1523,42 +1538,25 @@ class SearchNode:
                 return {}
             with self._placement_lock:
                 sizes = dict(self._size_cache[1])
-            batches: dict[str, list[dict]] = {}
-            files: dict[str, list[tuple[str, bytes]]] = {}
+            assignments: dict[str, list[str]] = {}
             for name, reps in sorted(under.items()):
-                data = self._store_read(name)
-                if data is None:
-                    # a NEW leader has no durable store of its own for
-                    # documents placed under a predecessor: fall back to
-                    # the download probe (local engine dir first, then
-                    # the surviving replicas) and cache the bytes so
-                    # future repairs are store-local again
-                    try:
-                        data = self.leader_download(name)
-                    except Exception:
-                        data = None
-                    if data is not None:
-                        self._store_document(name, data)
+                # _load_doc_bytes covers the new-leader case (no store
+                # of its own for a predecessor's placements: download
+                # probe + cache back into the store)
+                data = self._load_doc_bytes(name)
                 if data is None:
                     repaired_missing += 1
                     continue
                 cands = sorted(
                     (w for w in live
                      if w not in reps and w in sizes
+                     and w not in draining
                      and not self.resilience.board.is_open(w)),
                     key=lambda w: (sizes[w], w))
                 for target in cands[:r - len(reps)]:
                     sizes[target] = sizes.get(target, 0) + len(data)
-                    try:
-                        batches.setdefault(target, []).append(
-                            {"name": name, "text": data.decode("utf-8")})
-                    except UnicodeDecodeError:
-                        files.setdefault(target, []).append((name, data))
-            for target, docs in batches.items():
-                added += self._add_replica_batch(target, docs)
-            for target, items in files.items():
-                for name, data in items:
-                    added += self._add_replica_file(target, name, data)
+                    assignments.setdefault(name, []).append(target)
+            added += self._replicate_to_targets(assignments)
             if added:
                 global_metrics.inc("repair_docs_replicated", added)
         trimmed = self.placement.trim_plan(live, r)
@@ -1573,6 +1571,51 @@ class SearchNode:
                                repaired_missing)
         return {"replicated": added, "trimmed": n_trim,
                 "missing": repaired_missing}
+
+    def _load_doc_bytes(self, name: str) -> bytes | None:
+        """Byte source for replica/migration copies: the leader's
+        durable store first, else the download probe (its own engine
+        dir, then surviving replicas), caching probe hits back into
+        the store so future copies are store-local."""
+        data = self._store_read(name)
+        if data is not None:
+            return data
+        try:
+            data = self.leader_download(name)
+        except Exception:
+            data = None
+        if data is not None:
+            self._store_document(name, data)
+        return data
+
+    def _replicate_to_targets(self,
+                              assignments: dict[str, list[str]]) -> int:
+        """Fan NEW replica copies out to their assigned workers — text
+        docs grouped into one upload-batch per worker, binary docs
+        per-file — recording accepted copies in the placement map.
+        Shared by the anti-entropy repair pass and the rebalancer's
+        migration copy phase. Returns the number of confirmed legs."""
+        batches: dict[str, list[dict]] = {}
+        files: dict[str, list[tuple[str, bytes]]] = {}
+        for name, targets in assignments.items():
+            data = self._load_doc_bytes(name)
+            if data is None:
+                log.warning("no byte source for replica copy; leaving "
+                            "the doc where it is", file=name)
+                continue
+            for target in targets:
+                try:
+                    batches.setdefault(target, []).append(
+                        {"name": name, "text": data.decode("utf-8")})
+                except UnicodeDecodeError:
+                    files.setdefault(target, []).append((name, data))
+        n = 0
+        for target, docs in batches.items():
+            n += self._add_replica_batch(target, docs)
+        for target, items in files.items():
+            for name, data in items:
+                n += self._add_replica_file(target, name, data)
+        return n
 
     def _add_replica_batch(self, target: str, docs: list[dict]) -> int:
         """Forward one upload-batch of NEW replica copies to ``target``
@@ -1720,12 +1763,18 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
-        # route NEW names away from workers with open breakers (held
-        # names still go to their holders — replica continuity beats
-        # liveness); if every breaker is open, fall through and let the
-        # call fail honestly rather than refuse on stale breaker state
-        route_workers = [w for w in workers
-                         if not self.resilience.board.is_open(w)] or workers
+        # route NEW names away from workers with open breakers and from
+        # DRAINING workers (held names still go to their holders —
+        # replica continuity beats liveness, and an upsert must hit the
+        # current copies even mid-drain); if every candidate is
+        # excluded, fall through and let the call fail honestly rather
+        # than refuse on stale breaker/drain state
+        draining = self.placement.draining_snapshot()
+        route_workers = (
+            [w for w in workers if not self.resilience.board.is_open(w)
+             and w not in draining]
+            or [w for w in workers if w not in draining]
+            or workers)
         with self._placement_lock:
             held = tuple(w for w in self.placement.replicas.get(
                 filename, ()) if w in workers)
@@ -1813,9 +1862,13 @@ class SearchNode:
         workers = self.registry.get_all_service_addresses()
         if not workers:
             raise RuntimeError("no workers registered")
-        # same open-breaker routing rule as the per-file path
-        route_workers = [w for w in workers
-                         if not self.resilience.board.is_open(w)] or workers
+        # same open-breaker + draining routing rule as the per-file path
+        draining = self.placement.draining_snapshot()
+        route_workers = (
+            [w for w in workers if not self.resilience.board.is_open(w)
+             and w not in draining]
+            or [w for w in workers if w not in draining]
+            or workers)
         # validate BEFORE any tracking: a KeyError mid-planning-loop
         # would leak in-flight legs for docs already routed, pinning
         # those names to never-confirmed placements forever
@@ -2100,6 +2153,20 @@ class _NodeHandler(BaseHTTPRequestHandler):
                            else "I am a worker node")
             elif u.path == "/api/services":
                 self._json(node.registry.get_all_service_addresses())
+            elif u.path == "/api/drain":
+                # drain progress for one worker. Leader-only like the
+                # POST: a follower's placement map is reset on demotion,
+                # so it would answer a vacuous {"drained": true} and an
+                # operator's --wait poll could decommission a worker
+                # that still holds docs under the real leader
+                if not node.is_leader():
+                    self._text("not the leader", 409)
+                    return
+                worker = self._query_param(u, "worker")
+                if not worker:
+                    self._text("missing worker", 400)
+                    return
+                self._json(node.rebalancer.drain_status(worker))
             elif u.path == "/api/metrics":
                 snap = global_metrics.snapshot()
                 # live per-worker breaker states beside the counters —
@@ -2241,6 +2308,24 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 if removed:
                     node.notify_write()
                 self._json({"deleted": removed})
+            elif u.path == "/api/drain":
+                # planned decommission: migrate the worker empty before
+                # it leaves (leader-only — the drain mutates the
+                # authoritative placement map). Body: {"worker": url,
+                # "cancel": bool?}. The draining flag is durable, so a
+                # leader failover restarts the drain.
+                if not node.is_leader():
+                    self._text("not the leader", 409)
+                    return
+                req = json.loads(self._body().decode("utf-8"))
+                worker = req.get("worker")
+                if not isinstance(worker, str) or not worker:
+                    self._text("missing worker", 400)
+                    return
+                if req.get("cancel"):
+                    self._json(node.rebalancer.cancel_drain(worker))
+                else:
+                    self._json(node.rebalancer.start_drain(worker))
             elif u.path == "/admin/checkpoint":
                 # on-demand durability point (reference analog: the
                 # per-upload indexWriter.commit(), Worker.java:138)

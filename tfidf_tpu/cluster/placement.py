@@ -23,6 +23,14 @@ top of it:
   leader resumes with exact ownership instead of an empty in-memory
   map — closing the leader-failover double-count window the r5 advisor
   flagged (``_moved`` used to be leader-memory-only).
+- **Migration state** — the Rebalancer's staged live-migration records
+  (``copying -> flipped -> reconciled``; ``cluster/rebalance.py``) and
+  the draining-worker set ride the same durable znode, so a leader
+  failover mid-migration resumes or rolls back cleanly: a half-copied
+  range is never believed owned (copy legs are ordinary non-primary
+  confirmed replicas), and a flipped range is never re-flipped back
+  (the flip is one atomic in-memory mutation made durable before any
+  reconcile delete may run).
 
 Locking: one lock guards all map state. Persistence snapshots under the
 lock and performs the coordination write OUTSIDE it (the graftcheck
@@ -76,6 +84,17 @@ class PlacementMap:
         self.moved: dict[str, set[str]] = {}
         self._confirmed: dict[str, set[str]] = {}
         self._inflight: dict[tuple[str, str], int] = {}
+        # live-migration records (cluster/rebalance.py): migration id ->
+        # {"source", "targets": {name: [urls]}, "phase", "kind"}. Names
+        # under an active record are protected from the over-replication
+        # trim (the mid-copy target legs ARE over-replication until the
+        # flip). Persisted, so a new leader sees in-flight migrations.
+        self.migrations: dict[str, dict] = {}
+        self._mig_seq = 0
+        # workers being decommissioned (drain): excluded from new-name
+        # routing and from repair targets. Persisted, so a leader
+        # failover does not resurrect routing onto a half-drained node.
+        self.draining: set[str] = set()
         self.gen = 0              # bumped on every replica/moved change
         self._name = name
         # ---- persistence ----
@@ -176,6 +195,8 @@ class PlacementMap:
             self.replicas.clear()
             self.moved.clear()
             self._confirmed.clear()
+            self.migrations.clear()
+            self.draining.clear()
             self._owner_cache = None
             self.gen += 1
             self._dirty = False
@@ -228,6 +249,15 @@ class PlacementMap:
         kept: list[str] = []
         lost: list[str] = []
         with self.lock:
+            # a dead worker is no longer draining — the drain's purpose
+            # (migrate it empty before it leaves) is moot once it left.
+            # The clear must be PERSISTED even when the worker held no
+            # docs (the completed-drain decommission case): load()
+            # unions the draining set, so a stale durable flag would
+            # resurrect forever and exclude a later pod at the same
+            # stable URL from routing.
+            was_draining = worker in self.draining
+            self.draining.discard(worker)
             for name, reps in list(self.replicas.items()):
                 if worker not in reps:
                     continue
@@ -241,7 +271,7 @@ class PlacementMap:
                     del self.replicas[name]
                     self._confirmed.pop(name, None)
                     lost.append(name)
-            if kept or lost:
+            if kept or lost or was_draining:
                 self.gen += 1
                 self._mark_dirty_locked()
         return kept, lost
@@ -301,7 +331,15 @@ class PlacementMap:
         out: dict[str, list[str]] = {}
         with self.lock:
             changed = False
+            # names under an active migration are protected: their
+            # freshly-copied target legs ARE over-replication until the
+            # flip lands — trimming them would undo the copy phase
+            protected: set[str] = set()
+            for rec in self.migrations.values():
+                protected.update(rec.get("targets", ()))
             for name, reps in list(self.replicas.items()):
+                if name in protected:
+                    continue
                 # keepers are chosen among CONFIRMED live replicas
                 # only: a tentative in-flight upload leg must neither
                 # protect a slot (its leg may yet fail, and the trimmed
@@ -345,6 +383,143 @@ class PlacementMap:
             cur.discard(name)
             if not cur:
                 del self.moved[worker]
+
+    # ------------------------------------------------------------------
+    # live migration (the Rebalancer's staged state machine)
+    # ------------------------------------------------------------------
+
+    def begin_migration(self, source: str,
+                        targets_by_name: dict[str, list[str]],
+                        kind: str = "rebalance") -> str:
+        """Record a new migration in phase ``copying`` (durably, via
+        the normal dirty flush). Crash here or anywhere in the copy
+        phase is safe by construction: the copy legs land as ordinary
+        NON-primary confirmed replicas, so ownership never moves until
+        the flip — a new leader aborts a copying-phase record and the
+        trim pass reclaims any legs that confirmed."""
+        with self.lock:
+            self._mig_seq += 1
+            mid = f"m{self._mig_seq}"
+            self.migrations[mid] = {
+                "source": source,
+                "targets": {n: list(ts)
+                            for n, ts in targets_by_name.items()},
+                "phase": "copying", "kind": kind}
+            self.gen += 1
+            self._mark_dirty_locked()
+        return mid
+
+    def flip_migration(self, mid: str) -> list[str]:
+        """ONE atomic in-memory ownership flip for every name whose
+        migration targets CONFIRMED their copy: targets become the
+        leading replicas, the source leaves the replica set and its
+        copy is scheduled for reconcile-delete (``moved``). Names whose
+        copy never confirmed (or whose source already vanished) are
+        skipped — a half-copied range is never believed owned.
+
+        The caller must make the flip durable (``flush()``) BEFORE any
+        reconcile delete may run, and call :meth:`unflip_migration` if
+        it cannot — a non-durable flip followed by deletes would let a
+        leader failover resurrect source ownership of deleted copies.
+        Returns the flipped names."""
+        with self.lock:
+            rec = self.migrations.get(mid)
+            if rec is None or rec.get("phase") != "copying":
+                return []
+            src = rec["source"]
+            flipped: list[str] = []
+            prior: dict[str, tuple[str, ...]] = {}
+            for name, targets in rec["targets"].items():
+                reps = self.replicas.get(name)
+                if not reps or src not in reps:
+                    continue   # source already dropped/moved elsewhere
+                conf = self._confirmed.get(name, set())
+                tgts = [t for t in targets if t in conf and t in reps]
+                if not tgts:
+                    continue   # copy never confirmed: stays put
+                prior[name] = reps
+                rest = tuple(w for w in reps
+                             if w != src and w not in tgts)
+                self.replicas[name] = tuple(tgts) + rest
+                conf.discard(src)
+                self.moved.setdefault(src, set()).add(name)
+                flipped.append(name)
+            if flipped:
+                rec["phase"] = "flipped"
+                rec["prior"] = prior          # in-memory only (unflip)
+                rec["flipped"] = list(flipped)
+                self._owner_cache = None
+                self.gen += 1
+                self._mark_dirty_locked()
+            return flipped
+
+    def unflip_migration(self, mid: str) -> None:
+        """Roll a non-durable flip back (the flush failed or leadership
+        was lost): restore each flipped name's pre-flip replica order,
+        re-confirm the source (it held the doc before the flip and no
+        delete has run — the caller serializes against the reconcile
+        machinery), and cancel the scheduled deletes."""
+        with self.lock:
+            rec = self.migrations.get(mid)
+            if rec is None or rec.get("phase") != "flipped":
+                return
+            src = rec["source"]
+            for name, reps in rec.get("prior", {}).items():
+                if name not in self.replicas:
+                    continue
+                self.replicas[name] = tuple(reps)
+                self._confirmed.setdefault(name, set()).add(src)
+                self._unmove_locked(src, name)
+            rec["phase"] = "copying"
+            rec.pop("prior", None)
+            rec.pop("flipped", None)
+            self._owner_cache = None
+            self.gen += 1
+            self._mark_dirty_locked()
+
+    def end_migration(self, mid: str) -> None:
+        """Drop a migration record: after a DURABLE flip (the ``moved``
+        machinery owns the reconcile tail from here), or to abort a
+        copying-phase migration (confirmed copy legs become plain
+        over-replication for the trim pass to reclaim)."""
+        with self.lock:
+            if self.migrations.pop(mid, None) is not None:
+                self.gen += 1
+                self._mark_dirty_locked()
+
+    def migration_snapshot(self) -> dict[str, dict]:
+        with self.lock:
+            return {mid: {"source": rec["source"],
+                          "phase": rec.get("phase", "copying"),
+                          "kind": rec.get("kind", "rebalance"),
+                          "targets": {n: list(ts) for n, ts
+                                      in rec.get("targets", {}).items()}}
+                    for mid, rec in self.migrations.items()}
+
+    def migrating_names(self) -> set[str]:
+        with self.lock:
+            out: set[str] = set()
+            for rec in self.migrations.values():
+                out.update(rec.get("targets", ()))
+            return out
+
+    def set_draining(self, worker: str, on: bool) -> bool:
+        """Mark/unmark a worker as decommissioning. Returns True when
+        the flag actually changed."""
+        with self.lock:
+            if on == (worker in self.draining):
+                return False
+            if on:
+                self.draining.add(worker)
+            else:
+                self.draining.discard(worker)
+            self.gen += 1
+            self._mark_dirty_locked()
+            return True
+
+    def draining_snapshot(self) -> frozenset:
+        with self.lock:
+            return frozenset(self.draining)
 
     # ------------------------------------------------------------------
     # ownership
@@ -511,11 +686,23 @@ class PlacementMap:
             keep = [w for w in ws if w in conf]
             if keep:
                 reps[name] = keep
-        return json.dumps({
-            "v": 1,
+        out = {
+            "v": 2,
             "replicas": reps,
             "moved": {w: sorted(ns) for w, ns in self.moved.items() if ns},
-        }).encode()
+        }
+        # migration records persist only their durable fields — the
+        # unflip bookkeeping ("prior") is same-process-rollback state
+        if self.migrations:
+            out["migrations"] = {
+                mid: {"source": rec["source"],
+                      "targets": rec.get("targets", {}),
+                      "phase": rec.get("phase", "copying"),
+                      "kind": rec.get("kind", "rebalance")}
+                for mid, rec in self.migrations.items()}
+        if self.draining:
+            out["draining"] = sorted(self.draining)
+        return json.dumps(out).encode()
 
     def load(self) -> int:
         """Merge the persisted map into memory (new-leader resume).
@@ -535,6 +722,8 @@ class PlacementMap:
         loaded = {n: tuple(ws) for n, ws in state.get("replicas",
                                                       {}).items()}
         moved = {w: set(ns) for w, ns in state.get("moved", {}).items()}
+        migrations = state.get("migrations", {})
+        draining = set(state.get("draining", ()))
         with self.lock:
             n = 0
             for name, ws in loaded.items():
@@ -549,7 +738,17 @@ class PlacementMap:
                         if w not in self.replicas.get(nm, ())}
                 if not cur:
                     del self.moved[w]
-            if n or moved:
+            # a predecessor's in-flight migrations: adopt the records
+            # (the Rebalancer's resume pass then aborts copying-phase
+            # ones and lets the moved machinery finish flipped ones)
+            # and keep the id sequence past them so new migrations this
+            # tenure never collide with a loaded record
+            for mid, rec in migrations.items():
+                self.migrations.setdefault(mid, dict(rec))
+                if mid[:1] == "m" and mid[1:].isdigit():
+                    self._mig_seq = max(self._mig_seq, int(mid[1:]))
+            self.draining |= draining
+            if n or moved or migrations or draining:
                 self.gen += 1
         global_metrics.inc("placement_loads")
         global_metrics.set_gauge("placement_loaded_docs", n)

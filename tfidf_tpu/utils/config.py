@@ -234,6 +234,24 @@ class Config:
     # persistence (per-tenure map only).
     placement_flush_ms: float = 50.0
 
+    # --- elastic rebalancing (cluster/rebalance.py) ---
+    # Leader-side live shard migration: the sweep loop detects
+    # overloaded shards (doc count above the cluster mean + slack, or
+    # above the absolute cap below) and underused capacity (a freshly
+    # joined worker far below the mean) and migrates doc ranges live —
+    # copy to targets, durably flip ownership through the placement
+    # znode, reconcile-delete the old copies. Searches stay exact
+    # throughout (per-request owner assignment makes the flip atomic).
+    rebalance_enabled: bool = True
+    # Absolute per-worker doc-count cap: a shard above it donates docs
+    # even when the cluster is otherwise balanced. 0 = no cap
+    # (balance-to-mean only).
+    rebalance_max_shard_docs: int = 0
+    # Self-pacing for the rebalance pass inside the reconcile sweep
+    # loop (the sweep interval is the floor). Negative disables the
+    # automatic pass; /api/drain and run_once() still work.
+    rebalance_sweep_ms: float = 5000.0
+
     # --- coordination durability + quorum (cluster/wal.py, ensemble.py) ---
     # Empty data dir = in-memory substrate (the pre-durability behavior).
     # Set it and every coordinator write goes through a CRC-framed,

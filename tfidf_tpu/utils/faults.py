@@ -39,6 +39,16 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
                      "leader (restore R / trim over-replication)",
     "leader.placement_persist": "leader persisting the placement map to "
                                 "the coordination substrate",
+    "leader.rebalance_copy": "rebalancer about to copy a migrating doc "
+                             "range to its targets (pre-copy crash "
+                             "window: ownership has not moved)",
+    "leader.rebalance_flip": "rebalancer about to atomically flip "
+                             "ownership of a copied range (pre-flip "
+                             "crash window: the copy legs are plain "
+                             "over-replication)",
+    "leader.rebalance_reconcile": "rebalancer about to trigger the "
+                                  "reconcile deletes after a durable "
+                                  "flip (failure retried by the sweep)",
     "worker.process": "worker handling /worker/process[-batch]",
     "worker.upload": "worker handling /worker/upload[-batch]",
     "coord.heartbeat.*": "coordination server receiving a session "

@@ -26,6 +26,13 @@
 #                     a concurrent search workload asserting exact
 #                     single-node-oracle merge parity on every response
 #                     (tests/test_rebalance.py -m slow)
+#   make chaos-overload  slow overload chaos job: 2x-overload zipfian
+#                     closed loop against the admission front door with
+#                     a real mid-run worker kill -9 AND a cache-
+#                     invalidating upsert — shed rate rises, p99 of
+#                     ADMITTED interactive queries stays bounded, every
+#                     admitted result in exact single-node-oracle
+#                     parity (tests/test_admission.py -m slow)
 #   make faults       list every registered fault point (chaos configs
 #                     should be validated against this — see
 #                     utils/faults.py)
@@ -34,6 +41,9 @@
 #                     (VERDICT r5 Weak #3): two independently fetchable
 #                     device programs + the pipeline executor on a fake
 #                     workload; writes PROBE_OVERLAP.json
+#   make bench-overload  zipfian closed-loop overload bench (1x and 2x
+#                     saturating concurrency, per-lane p50/p99 latency,
+#                     shed rate, cache hit rate); writes OVERLOAD.json
 
 #   make graftcheck   project-native static analysis (tools/graftcheck):
 #                     lock-graph/deadlock, jit-purity, registry drift,
@@ -47,8 +57,9 @@
 
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: test chaos chaos-coord chaos-replica chaos-rebalance faults \
-        bench probe-overlap graftcheck lockdep check
+.PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
+        chaos-overload faults bench bench-overload probe-overlap \
+        graftcheck lockdep check
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -67,7 +78,7 @@ lockdep:
 	JAX_PLATFORMS=cpu GRAFTCHECK_LOCKDEP=1 python -m pytest \
 	  tests/test_resilience.py tests/test_cluster.py \
 	  tests/test_replication.py tests/test_rebalance.py \
-	  tests/test_graftcheck.py \
+	  tests/test_admission.py tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 check: graftcheck test
@@ -84,6 +95,9 @@ chaos-replica:
 chaos-rebalance:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_rebalance.py $(PYTEST_FLAGS) -m slow
 
+chaos-overload:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_admission.py $(PYTEST_FLAGS) -m slow
+
 faults:
 	python -m tfidf_tpu faults list
 
@@ -92,3 +106,6 @@ bench:
 
 probe-overlap:
 	python probe_overlap.py
+
+bench-overload:
+	BENCH_OUT=OVERLOAD.json python bench.py --overload

@@ -61,7 +61,13 @@ _CFG = dict(
     min_vocab_capacity=1 << 10, query_batch=8, max_query_terms=8,
     rpc_max_attempts=1,            # deterministic: no hidden retries
     breaker_failure_threshold=2, breaker_reset_s=0.4,
-    reconcile_sweep_interval_s=0.2, placement_flush_ms=10.0)
+    reconcile_sweep_interval_s=0.2, placement_flush_ms=10.0,
+    # this suite asserts SCATTER mechanics (failover RPCs, breaker
+    # fires, hedges) on repeated identical queries — a leader-side
+    # result-cache hit would (correctly) answer without any fan-out
+    # and mask exactly what is under test (the cache has its own
+    # suite, tests/test_admission.py)
+    result_cache_entries=0)
 
 
 def _node(core, tmp_path, i, port=0, **kw):

@@ -287,7 +287,12 @@ _RESILIENCE_CFG = dict(
     # single-copy placement: this suite pins the PRE-replication
     # degraded/recovery semantics (R-way failover has its own suite,
     # tests/test_replication.py)
-    replication_factor=1)
+    replication_factor=1,
+    # no result cache: these tests re-issue identical queries around
+    # armed faults and count the resulting scatter RPCs/breaker fires
+    # — a cache hit would (correctly) skip the fan-out and mask them
+    # (the cache has its own suite, tests/test_admission.py)
+    result_cache_entries=0)
 
 
 def _node(core, tmp_path, i, port=0, **kw):

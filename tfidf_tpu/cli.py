@@ -291,8 +291,35 @@ def _leader_url(args) -> str:
     return args.leader.rstrip("/")
 
 
-def cmd_upload(args) -> int:
+def _shed_aware_post(url: str, data: bytes,
+                     content_type: str = "application/json") -> bytes:
+    """POST to the leader honoring its admission layer: a 429 shed is
+    retried only AFTER its ``Retry-After`` hint has elapsed (the
+    default classifier + RetryPolicy floor — see resilience.py), and a
+    request still shed after the bounded attempts exits with the shed
+    message instead of a traceback. The CLI must model the polite
+    client: hammering a saturated leader from the operator's own
+    tooling would amplify the overload the shed is relieving."""
+    import urllib.error
+
     from tfidf_tpu.cluster.node import http_post
+    from tfidf_tpu.cluster.resilience import RetryPolicy, retry_after_of
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.05, name="cli")
+    try:
+        return policy.call(
+            lambda: http_post(url, data, content_type=content_type))
+    except urllib.error.HTTPError as e:
+        ra = retry_after_of(e)
+        if ra is None:
+            raise
+        print(f"leader is shedding load (429, reason="
+              f"{e.headers.get('X-Shed-Reason', '?')}): retry after "
+              f"{ra:.3f}s", file=sys.stderr)
+        raise SystemExit(75)   # EX_TEMPFAIL: try again later
+
+
+def cmd_upload(args) -> int:
 
     if getattr(args, "batch", False):
         from tfidf_tpu.ops.analyzer import (UnsupportedMediaType,
@@ -328,7 +355,7 @@ def cmd_upload(args) -> int:
                     print(f"skipped {name}: {e}", file=sys.stderr)
             if not docs:
                 continue
-            resp = json.loads(http_post(
+            resp = json.loads(_shed_aware_post(
                 _leader_url(args) + "/leader/upload-batch",
                 json.dumps(docs).encode()))
             total += sum(resp.get("placed", {}).values())
@@ -344,17 +371,16 @@ def cmd_upload(args) -> int:
         with open(path, "rb") as f:
             data = f.read()
         name = urllib.parse.quote(os.path.basename(path))
-        resp = http_post(_leader_url(args) + f"/leader/upload?name={name}",
-                         data, content_type="application/octet-stream")
+        resp = _shed_aware_post(
+            _leader_url(args) + f"/leader/upload?name={name}",
+            data, content_type="application/octet-stream")
         print(resp.decode())
     return 0
 
 
 def cmd_query(args) -> int:
-    from tfidf_tpu.cluster.node import http_post
-
     body = json.dumps({"query": " ".join(args.query)}).encode()
-    resp = http_post(_leader_url(args) + "/leader/start", body)
+    resp = _shed_aware_post(_leader_url(args) + "/leader/start", body)
     print(resp.decode())
     return 0
 
@@ -407,6 +433,24 @@ def cmd_status(args) -> int:
         "drains_started": int(metrics.get("rebalance_drains_started", 0)),
         "drains_completed":
             int(metrics.get("rebalance_drains_completed", 0)),
+    }
+    # overload summary (README "Overload & admission control"): is the
+    # front door shedding, why, and is the result cache earning its keep
+    hits = metrics.get("cache_hits", 0)
+    misses = metrics.get("cache_misses", 0)
+    out["admission"] = {
+        "admitted_total": int(metrics.get("admission_admitted", 0)),
+        "shed_total": int(metrics.get("admission_shed_total", 0)),
+        "shed_rate_limited":
+            int(metrics.get("admission_shed_rate_limited", 0)),
+        "shed_backpressure":
+            int(metrics.get("admission_shed_backpressure", 0)),
+        "last_queue_depth": metrics.get("admission_last_depth", 0),
+        "cache_hits": int(hits),
+        "cache_misses": int(misses),
+        "cache_hit_rate": round(hits / (hits + misses), 3)
+            if (hits + misses) else 0.0,
+        "cache_entries": int(metrics.get("cache_entries", 0)),
     }
     print(json.dumps(out, indent=2))
     return 0

@@ -28,15 +28,16 @@ log = get_logger("cluster.batcher")
 
 
 class _Waiter:
-    __slots__ = ("query", "event", "result", "error", "t0", "key")
+    __slots__ = ("query", "event", "result", "error", "t0", "key", "lane")
 
-    def __init__(self, query) -> None:
+    def __init__(self, query, lane: int = 0) -> None:
         self.query = query   # the submitted item (any shape)
         self.event = threading.Event()
         self.result = None
         self.error: BaseException | None = None
         self.t0 = 0.0   # submit time (linger accounting)
         self.key = None  # group key, stamped at SUBMIT time
+        self.lane = lane  # 0 = interactive, 1 = bulk (weighted dequeue)
 
 
 class Coalescer:
@@ -49,13 +50,23 @@ class Coalescer:
     queueing delay separately from RPC time.
 
     ``pipeline`` dispatcher threads let one batch's RPC round trip
-    overlap the next batch's formation."""
+    overlap the next batch's formation.
+
+    Two priority lanes (``submit(item, lane=...)``): lane 0
+    (interactive) and lane 1 (bulk). Batch formation is a WEIGHTED
+    dequeue — the interactive queue always fills first (so bulk can
+    never starve interactive: every dispatch round that finds an
+    interactive item queued dispatches it), but while interactive
+    traffic saturates a batch, ``bulk_share`` of the slots are reserved
+    for queued bulk items so bulk starves neither. Unused reservation
+    in either direction is returned to the other lane."""
 
     def __init__(self, batch_fn, *, max_batch: int = 128,
                  linger_s: float = 0.002, pipeline: int = 2,
                  name: str = "coalesce", group_key=None,
                  linger_min_s: float | None = None,
-                 linger_max_s: float | None = None) -> None:
+                 linger_max_s: float | None = None,
+                 bulk_share: float = 0.25) -> None:
         """``group_key(item)``, when given, keeps a batch homogeneous:
         only leading queued items sharing the head's key join it; the
         rest stay queued in order for the next dispatcher round. The
@@ -79,8 +90,10 @@ class Coalescer:
         self._linger_hi = linger_s if linger_max_s is None else linger_max_s
         self.name = name
         self.group_key = group_key
+        self.bulk_share = min(max(bulk_share, 0.0), 1.0)
         self._lock = threading.Lock()
-        self._items: deque[_Waiter] = deque()
+        self._items: deque[_Waiter] = deque()   # lane 0: interactive
+        self._bulk: deque[_Waiter] = deque()    # lane 1: bulk/batch
         self._wake = threading.Event()
         self._stopping = False
         self._dispatching = 0   # batch_fn calls in flight (adaptive linger)
@@ -91,8 +104,8 @@ class Coalescer:
         for t in self._threads:
             t.start()
 
-    def submit(self, item):
-        w = _Waiter(item)
+    def submit(self, item, lane: int = 0):
+        w = _Waiter(item, lane=1 if lane else 0)
         w.t0 = time.perf_counter()
         if self.group_key is not None:
             w.key = self.group_key(item)
@@ -105,7 +118,7 @@ class Coalescer:
                 # steady load, abandoned waiters would otherwise grow
                 # _items without bound
                 raise RuntimeError(f"{self.name} dispatchers died")
-            self._items.append(w)
+            (self._bulk if w.lane else self._items).append(w)
         self._wake.set()
         # bounded-slice wait + shutdown check (graftcheck lockgraph
         # indefinite-wait audit): a dispatcher that died mid-batch must
@@ -119,7 +132,8 @@ class Coalescer:
                 if not w.event.wait(timeout=2.0):
                     with self._lock:
                         try:
-                            self._items.remove(w)
+                            (self._bulk if w.lane
+                             else self._items).remove(w)
                         except ValueError:
                             pass   # already popped into a batch
                     raise RuntimeError(
@@ -131,6 +145,19 @@ class Coalescer:
             raise w.error
         return w.result
 
+    def backlog(self) -> int:
+        """LIVE queued items beyond one batch's worth — the admission
+        layer's stall-proof overload signal. The ``last_*_queue_depth``
+        gauge is only refreshed at batch formation, so it freezes at
+        its last value while every dispatcher thread is blocked inside
+        a stalled ``batch_fn`` RPC — exactly when the queue grows
+        fastest. This reads the deques directly (unlocked ``len`` is a
+        single atomic read; an off-by-a-few heuristic is fine for a
+        watermark). One batch's worth is subtracted because a healthy
+        linger window legitimately accumulates up to ``max_batch``
+        items that the next formation round will take."""
+        return max(0, len(self._items) + len(self._bulk) - self.max_batch)
+
     def stop(self) -> None:
         with self._lock:
             self._stopping = True
@@ -138,7 +165,8 @@ class Coalescer:
         for t in self._threads:
             t.join(timeout=2.0)
         with self._lock:
-            items, self._items = list(self._items), deque()
+            items = list(self._items) + list(self._bulk)
+            self._items, self._bulk = deque(), deque()
         for w in items:
             w.error = RuntimeError(f"{self.name} stopped")
             w.event.set()
@@ -183,31 +211,28 @@ class Coalescer:
                 # saturation (a full batch already queued) the wait buys
                 # nothing and would tax every query's latency
                 with self._lock:
-                    full = len(self._items) >= self.max_batch
+                    full = (len(self._items) + len(self._bulk)
+                            >= self.max_batch)
                 if not full:
                     threading.Event().wait(linger)
                     waited = linger
             with self._lock:
-                batch = []
-                if self._items:
-                    first = self._items.popleft()
-                    batch.append(first)
-                    key = first.key   # stamped at submit time
-                    while (self._items and len(batch) < self.max_batch
-                           and (self.group_key is None
-                                or self._items[0].key == key)):
-                        batch.append(self._items.popleft())
-                depth = len(self._items)
-                if not self._items and not self._stopping:
+                batch = self._form_batch_locked()
+                depth = len(self._items) + len(self._bulk)
+                bulk_depth = len(self._bulk)
+                if depth == 0 and not self._stopping:
                     # never clear after stop() set the event, or sibling
                     # dispatcher threads park in _wake.wait() forever
                     self._wake.clear()
             # queue depth LEFT BEHIND after this batch formed: the
             # serving-pressure signal the k8s HPA scales workers on
-            # (deploy/k8s.yaml) — 0 in steady state, grows when offered
-            # load outruns the dispatch pipeline
+            # (deploy/k8s.yaml) AND the admission layer's backpressure
+            # input (cluster/admission.py) — 0 in steady state, grows
+            # when offered load outruns the dispatch pipeline
             global_metrics.set_gauge(f"last_{self.name}_queue_depth",
                                      depth)
+            global_metrics.set_gauge(f"last_{self.name}_bulk_depth",
+                                     bulk_depth)
             if not batch:
                 continue
             try:
@@ -224,6 +249,41 @@ class Coalescer:
                             f"{self.name} dispatcher died: {e!r}")
                         w.event.set()
                 raise
+
+    def _form_batch_locked(self) -> list[_Waiter]:
+        """Weighted two-lane dequeue; caller holds ``self._lock``.
+
+        The interactive head is popped FIRST whenever that lane is
+        nonempty — so a dispatch round can never serve bulk while an
+        interactive request waits (bulk starving interactive is
+        impossible by construction). While interactive saturates the
+        batch, ``bulk_share`` of the slots are reserved for
+        key-compatible queued bulk items so bulk makes progress too;
+        reservation either lane does not use returns to the other.
+        Group-key homogeneity holds across lanes: the batch key is the
+        first popped item's submit-time key, and only head items
+        matching it (from either lane) join."""
+        lead = self._items or self._bulk
+        if not lead:
+            return []
+        first = lead.popleft()
+        batch = [first]
+        key = first.key   # stamped at submit time
+
+        def head_ok(dq) -> bool:
+            return bool(dq) and (self.group_key is None
+                                 or dq[0].key == key)
+
+        reserve = 0
+        if first.lane == 0 and self.bulk_share > 0 and head_ok(self._bulk):
+            reserve = max(1, int(self.max_batch * self.bulk_share))
+        while head_ok(self._items) and len(batch) < self.max_batch - reserve:
+            batch.append(self._items.popleft())
+        while head_ok(self._bulk) and len(batch) < self.max_batch:
+            batch.append(self._bulk.popleft())
+        while head_ok(self._items) and len(batch) < self.max_batch:
+            batch.append(self._items.popleft())
+        return batch
 
     def _dispatch_batch(self, batch: list[_Waiter],
                         waited: float) -> None:

@@ -35,6 +35,7 @@ from __future__ import annotations
 import email.parser
 import email.policy
 import json
+import math
 import os
 import threading
 import time
@@ -46,6 +47,8 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from concurrent.futures import wait as _fwait
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tfidf_tpu.cluster.admission import (LANE_BULK, LANE_INTERACTIVE,
+                                         AdmissionController, ResultCache)
 from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
 from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
                                     unpack_hit_lists)
@@ -151,14 +154,21 @@ class _ScatterClient:
                 body = r.read()
                 if r.status >= 300:
                     # typed status error: the resilience layer retries
-                    # gateway-transient statuses (502/503/504), never
+                    # gateway-transient statuses (502/503/504) and —
+                    # only after Retry-After — 429 sheds; never other
                     # 4xx (application), deterministic 500s, or a
                     # worker's honest deadline refusal (the budget
                     # cannot come back — see X-Deadline-Ms)
+                    ra = r.getheader("Retry-After")
+                    try:
+                        ra_s = float(ra) if ra else None
+                    except ValueError:
+                        ra_s = None   # HTTP-date form: treat as absent
                     raise RpcStatusError(
                         f"{base}{path}", r.status,
                         deadline_exceeded=(
-                            r.getheader("X-Deadline-Exceeded") == "1"))
+                            r.getheader("X-Deadline-Exceeded") == "1"),
+                        retry_after_s=ra_s)
                 return body
             except RuntimeError:
                 raise
@@ -269,10 +279,49 @@ class SearchNode:
             linger_s=self.config.scatter_linger_ms / 1e3,
             pipeline=self.config.scatter_pipeline, name="scatter",
             group_key=lambda _q: self._cluster_epoch,
+            bulk_share=self.config.scatter_bulk_share,
             **_linger_bounds(self.config.scatter_linger_min_ms,
                              self.config.scatter_linger_max_ms))
             if (self.config.scatter_micro_batch
                 and not self.config.unbounded_results) else None)
+        # overload-survival front door (cluster/admission.py): the
+        # /leader/* handlers admit-or-shed BEFORE any work is queued,
+        # keyed on the scatter coalescer's queue depth + per-client
+        # token buckets. /api/health and /api/metrics never pass
+        # through it. The depth signal is the MAX of the left-behind
+        # gauge (the k8s HPA signal, refreshed at batch formation) and
+        # the coalescer's live backlog — the gauge alone freezes while
+        # every dispatcher thread is blocked in a stalled scatter RPC,
+        # which is exactly when admitted requests would otherwise queue
+        # unboundedly with zero sheds.
+        self.admission = AdmissionController(
+            self.config,
+            depth_fn=lambda: max(
+                global_metrics.get("last_scatter_queue_depth", 0.0),
+                float(self.scatter_batcher.backlog())
+                if self.scatter_batcher is not None else 0.0))
+        # leader-side query-result cache, keyed by df_signature(): the
+        # (membership epoch, commit generation) token advances on every
+        # mutation this leader orchestrates — confirmed upload legs,
+        # reconcile deletes, migration flips, membership transitions —
+        # so a cached result can never outlive the corpus state it was
+        # computed from (no TTL; invalidation rides the same version
+        # plumbing that keys the engine's segment view cache)
+        # disabled for unbounded-results (parity) configs, mirroring
+        # scatter_batcher above: without top-k truncation every cached
+        # value is a full-corpus score dict, so the entry-count bound
+        # is no memory bound at all (1024 entries x 1M-doc dicts)
+        self.result_cache = (ResultCache(self.config.result_cache_entries)
+                             if (self.config.result_cache_entries > 0
+                                 and not self.config.unbounded_results)
+                             else None)
+        self._result_gen = 0
+        self._result_gen_lock = threading.Lock()
+        # cached role for /api/health: the real is_leader() is a
+        # coordination READ (an RPC on the client transport) — the
+        # health endpoint must stay responsive while the cluster sheds,
+        # so it reports the last role transition instead of blocking
+        self._role = "worker"
         # near-real-time commit policy (Lucene NRT readers): uploads
         # defer the commit; the next search commits pending writes first,
         # so read-your-writes visibility matches the reference's
@@ -595,6 +644,27 @@ class SearchNode:
         """Mark uncommitted writes (called by the upload handler)."""
         self._dirty = True
 
+    # ---- result-cache generation (cluster/admission.py) ----
+
+    def bump_result_generation(self) -> None:
+        """Advance the df-signature commit generation: any mutation
+        that could change a score (a confirmed upload leg, a reconcile
+        delete, a migration flip, a direct worker-side write) calls
+        this, so every cached query result stamped with an older token
+        dies at its next lookup."""
+        with self._result_gen_lock:
+            self._result_gen += 1
+
+    def df_signature(self) -> tuple[int, int]:
+        """The result cache's generation token: (membership epoch,
+        commit generation). The epoch component covers everything that
+        changes WHICH shards answer (worker death/join shifts
+        per-shard df); the generation component covers every commit
+        the leader orchestrates on unchanged membership."""
+        with self._result_gen_lock:
+            gen = self._result_gen
+        return (self._cluster_epoch, gen)
+
     def commit_if_dirty(self) -> None:
         """NRT visibility point: flush deferred upload commits before
         serving a search. Clearing the flag before committing means a
@@ -681,6 +751,7 @@ class SearchNode:
     # ---- role transitions (leader/OnElectionAction.java:27-77) ----
 
     def on_elected_to_be_leader(self) -> None:
+        self._role = "leader"   # cached for the non-blocking /api/health
         # the leader does not serve a shard: leave the worker pool (:30)
         self.registry.unregister_from_cluster()
         self.registry.register_for_updates()
@@ -752,6 +823,7 @@ class SearchNode:
             log.warning("placement resume pass failed", err=repr(e))
 
     def on_worker(self) -> None:
+        self._role = "worker"   # cached for the non-blocking /api/health
         # a worker must never write the leader's placement state, and
         # a DEMOTED ex-leader must not carry its tenure's map into a
         # possible later re-promotion — the durable znode (written by
@@ -767,7 +839,8 @@ class SearchNode:
 
     # ---- leader logic (leader/Leader.java) ----
 
-    def leader_search(self, query: str) -> dict[str, float]:
+    def leader_search(self, query: str,
+                      lane: str = LANE_INTERACTIVE) -> dict[str, float]:
         """Scatter-gather search (``Leader.java:39-92``): fan the query out
         to every registered worker, tolerate per-worker failure, sum-merge
         scores by document name.
@@ -776,23 +849,45 @@ class SearchNode:
         per worker (:meth:`_scatter_search_batch`). The per-query JSON
         fan-out below remains for unbounded-results (parity) configs and
         ``scatter_micro_batch=False``."""
-        return self.leader_search_with_health(query)[0]
+        return self.leader_search_with_health(query, lane=lane)[0]
 
     # per-query JSON scatter budget (the reference's 10s RestTemplate
     # default) — propagated to workers as X-Deadline-Ms like the
     # batched path's scatter_timeout_s
     _PER_QUERY_BUDGET_S = 10.0
 
-    def leader_search_with_health(self, query: str
+    def leader_search_with_health(self, query: str,
+                                  lane: str = LANE_INTERACTIVE
                                   ) -> tuple[dict[str, float], dict]:
         """``leader_search`` plus this request's OWN health marker —
         ``(merged, {attempted, responded, circuit_open, degraded,
         failovers, dark})``. The handler stamps the degraded header
         from the returned value: reading it back off shared node state
         would let two concurrent scatters mislabel each other's
-        replies."""
+        replies.
+
+        ``lane`` routes the query through the scatter coalescer's
+        weighted dequeue (bulk can never starve interactive). The
+        result cache is consulted first: the generation token is
+        captured BEFORE dispatch, so a commit that lands mid-scatter
+        invalidates the entry this request inserts — a cached result
+        can never be newer-keyed than the corpus state it saw."""
+        token = self.df_signature()
+        if self.result_cache is not None:
+            hit = self.result_cache.get(query, token)
+            if hit is not None:
+                # a cache hit did no fan-out: its health marker says so
+                # (and is never recorded into the shared gauges — it
+                # would misreport the last real scatter's health)
+                return hit, {"attempted": 0, "responded": 0,
+                             "circuit_open": 0, "degraded": 0,
+                             "failovers": 0, "dark": 0, "cached": 1}
         if self.scatter_batcher is not None:
-            return self.scatter_batcher.submit(query)
+            result, health = self.scatter_batcher.submit(
+                query, lane=1 if lane == LANE_BULK else 0)
+            if self.result_cache is not None and not health.get("degraded"):
+                self.result_cache.put(query, token, result)
+            return result, health
         log.info("scatter search", query=query)
         body = json.dumps({"query": query}).encode()
         t_deadline = time.monotonic() + self._PER_QUERY_BUDGET_S
@@ -813,7 +908,10 @@ class SearchNode:
                      for h in hits]]
 
         merged, health = self._gather_merge([query], rpc_one, t_deadline)
-        return self._order_merged(merged[0]), health
+        result = self._order_merged(merged[0])
+        if self.result_cache is not None and not health.get("degraded"):
+            self.result_cache.put(query, token, result)
+        return result, health
 
     def _pending_reconcile(self) -> dict[str, frozenset]:
         """Names moved AWAY from each worker whose rejoin reconcile has
@@ -1340,6 +1438,8 @@ class SearchNode:
             return False
         # names moved DURING the RPC stay pending
         self.placement.moved_resolved(w, moved)
+        # the confirmed deletes changed that worker's df — invalidate
+        self.bump_result_generation()
         global_metrics.inc("reconciles_completed")
         log.info("reconciled rejoined worker", worker=w,
                  deleted=resp.get("deleted", 0))
@@ -1718,6 +1818,9 @@ class SearchNode:
         the cache: re-inserting an evicted/unpolled worker at near-zero
         size would defeat the set-mismatch re-poll signal and min-route
         every new name onto it until TTL expiry)."""
+        # a confirmed copy changed that worker's shard (and its df) —
+        # cached query results stamped before this commit must die
+        self.bump_result_generation()
         self.placement.leg_success(name, worker)
         with self._placement_lock:
             sizes = self._size_cache[1]
@@ -2105,6 +2208,58 @@ class _NodeHandler(BaseHTTPRequestHandler):
             return True
         return False
 
+    # ---- admission plumbing (cluster/admission.py) ----
+
+    def _client_lane(self, default_lane: str) -> tuple[str, str]:
+        """(client id, lane) for admission: the ``X-Client-Id`` header
+        (falling back to the peer IP) and the ``X-Priority`` header
+        (``bulk`` selects the bulk lane; anything else keeps the
+        endpoint's default)."""
+        client = self.headers.get("X-Client-Id") or self.client_address[0]
+        prio = (self.headers.get("X-Priority") or "").strip().lower()
+        lane = LANE_BULK if prio == "bulk" else (
+            LANE_INTERACTIVE if prio == "interactive" else default_lane)
+        return client, lane
+
+    def _shed(self, decision) -> None:
+        """The explicit shed path: 429 + ``Retry-After``. The header
+        carries RFC 9110 delta-seconds (an integer — fractional values
+        are rejected or silently dropped by standards-compliant
+        clients), rounded UP so an obedient client is never early; the
+        JSON body's ``retry_after_s`` keeps the precise time-to-next-
+        token the rate-limit path computed. ``Connection: close`` is
+        explicit — the request body may be undrained, and a shedding
+        node must not hold keep-alive state for a client it just told
+        to go away (the header also tells pooled clients to drop the
+        connection instead of tripping over the server-side close).
+        The request body is drained up to a 1 MB cap first: closing
+        with unread data in the receive queue sends RST, which can
+        discard the 429 still in the client's buffer — the client
+        would see ECONNRESET, classify it transient, and retry with
+        no Retry-After floor, the exact hammering the shed exists to
+        stop. Beyond the cap the connection closes anyway (a shedding
+        node cannot hold the line for an arbitrarily large upload)."""
+        self.close_connection = True
+        try:
+            remaining = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            remaining = 0
+        remaining = min(remaining, 1 << 20)
+        while remaining > 0:
+            chunk = self.rfile.read(min(remaining, 1 << 16))
+            if not chunk:
+                break
+            remaining -= len(chunk)
+        body = json.dumps({"error": "overloaded",
+                           "reason": decision.reason,
+                           "retry_after_s": round(
+                               decision.retry_after_s, 3)}).encode()
+        self._send(429, body, headers={
+            "Retry-After": str(math.ceil(max(decision.retry_after_s,
+                                             0.0))),
+            "Connection": "close",
+            "X-Shed-Reason": decision.reason})
+
     def _read_query(self) -> str:
         """The search query: accept raw text (the reference POSTs the bare
         query string, ``Leader.java:54-59``) or ``{"query": ...}`` JSON."""
@@ -2132,11 +2287,31 @@ class _NodeHandler(BaseHTTPRequestHandler):
         u = urllib.parse.urlparse(self.path)
         node = self.node
         try:
-            if u.path == "/worker/index-size":
+            if u.path == "/api/health":
+                # the reserved observability lane: never admission-
+                # controlled, never blocks on coordination or serving
+                # locks (role is the cached last transition, depth is a
+                # gauge read) — so operators can SEE a shedding node.
+                # Each connection gets its own handler thread, so a
+                # saturated bulk flood cannot queue ahead of this.
+                self._json({
+                    "ok": True, "role": node._role,
+                    "scatter_queue_depth": global_metrics.get(
+                        "last_scatter_queue_depth", 0.0),
+                    "admission": node.admission.snapshot()})
+            elif u.path == "/worker/index-size":
                 self._text(str(node.engine.index_size_bytes()))
             elif u.path == "/worker/download":
                 self._download_from_engine(u)
             elif u.path == "/leader/download":
+                # the front door guards every /leader/* endpoint:
+                # checkpoint downloads are bulk transfers (real file
+                # I/O per request), first to shed under backpressure
+                client, lane = self._client_lane(LANE_BULK)
+                decision = node.admission.admit(client, lane)
+                if not decision.admitted:
+                    self._shed(decision)
+                    return
                 rel = urllib.parse.unquote(self._query_param(u, "path") or "")
                 try:
                     got = node.leader_download_stream(rel)
@@ -2272,6 +2447,10 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._text(f"unsupported media type: {e}", 415)
                     return
                 node.notify_write()
+                # a direct worker-side write also changes THIS node's
+                # df — keep its own result cache honest (dual-role and
+                # single-node deployments serve /leader/start here too)
+                node.bump_result_generation()
                 self._text(f"File {name} uploaded and indexed")
             elif u.path == "/worker/upload-batch":
                 docs = json.loads(self._body().decode("utf-8"))
@@ -2292,6 +2471,7 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     # next NRT flush, not be stranded uncommitted
                     if docs:
                         node.notify_write()
+                        node.bump_result_generation()
                 self._json({"indexed": len(docs) - len(skipped),
                             "skipped": skipped})
             elif u.path == "/worker/delete":
@@ -2307,6 +2487,7 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     for n in names)
                 if removed:
                     node.notify_write()
+                    node.bump_result_generation()
                 self._json({"deleted": removed})
             elif u.path == "/api/drain":
                 # planned decommission: migrate the worker empty before
@@ -2332,14 +2513,35 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 node.commit_if_dirty()
                 self._json(node.save_checkpoint())
             elif u.path == "/leader/upload-batch":
+                # uploads are bulk by default: first to shed under
+                # backpressure, so ingest never crowds out interactive
+                # search latency (admit BEFORE reading the body — a
+                # shed upload pays at most the 1 MB drain in _shed,
+                # never a JSON parse or an index slot)
+                client, lane = self._client_lane(LANE_BULK)
+                decision = node.admission.admit(client, lane)
+                if not decision.admitted:
+                    self._shed(decision)
+                    return
                 docs = json.loads(self._body().decode("utf-8"))
                 try:
                     self._json(node.leader_upload_batch(docs))
                 except ValueError as e:   # malformed client payload
                     self._text(str(e), 400)
             elif u.path == "/leader/start":
+                # front-door admission BEFORE any work is queued: a
+                # shed request costs one token-bucket check, not a
+                # coalescer slot (searches default to the interactive
+                # lane; X-Priority: bulk selects the bulk lane, which
+                # backpressure sheds first)
+                client, lane = self._client_lane(LANE_INTERACTIVE)
+                decision = node.admission.admit(client, lane)
+                if not decision.admitted:
+                    self._shed(decision)
+                    return
                 query = self._read_query()
-                result, health = node.leader_search_with_health(query)
+                result, health = node.leader_search_with_health(
+                    query, lane=lane)
                 # degraded marker: the body stays reference-compatible
                 # (name -> score), the header says whether every live
                 # worker's shard is represented in it
@@ -2356,6 +2558,11 @@ class _NodeHandler(BaseHTTPRequestHandler):
                                          "circuit_open")})}
                 self._json(result, headers=hdrs)
             elif u.path == "/leader/upload":
+                client, lane = self._client_lane(LANE_BULK)
+                decision = node.admission.admit(client, lane)
+                if not decision.admitted:
+                    self._shed(decision)
+                    return
                 name, data = self._read_upload(u)
                 if not name:
                     self._text("missing file name", 400)

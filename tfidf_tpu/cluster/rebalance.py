@@ -260,6 +260,11 @@ class Rebalancer:
                     # resurrect source ownership of deleted copies
                     node.placement.unflip_migration(mid)
                     flipped = []
+                if flipped:
+                    # a flip changes which shard SCORES each moved doc
+                    # (per-shard df shifts with ownership): cached
+                    # query results predate it and must die
+                    node.bump_result_generation()
                 out["moved"] = len(flipped)
                 out["failed"] = len(targets_by_name) - len(flipped)
             except Exception as e:

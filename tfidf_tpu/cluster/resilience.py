@@ -53,16 +53,23 @@ class RpcStatusError(RuntimeError):
     worker (or the leader's own pre-dispatch check) declining to start
     work whose caller budget is already spent. Unlike a gateway 504 it
     is never retried (the budget cannot come back) and never indicts
-    the worker (refusing honestly is healthy behavior)."""
+    the worker (refusing honestly is healthy behavior).
+
+    ``retry_after_s`` carries a 429 shed reply's ``Retry-After`` header
+    (the admission layer's honest back-off hint): the retry policy
+    never re-attempts BEFORE it has elapsed — see
+    :func:`retry_after_of`."""
 
     def __init__(self, url: str, status: int,
-                 deadline_exceeded: bool = False) -> None:
+                 deadline_exceeded: bool = False,
+                 retry_after_s: float | None = None) -> None:
         super().__init__(f"{url} -> {status}"
                          + (" (deadline exceeded)" if deadline_exceeded
                             else ""))
         self.url = url
         self.status = status
         self.deadline_exceeded = deadline_exceeded
+        self.retry_after_s = retry_after_s
 
 
 class CircuitOpenError(RuntimeError):
@@ -95,14 +102,39 @@ _CONNECTION_ERRORS = (
 # engine load rpc_max_attempts-fold per scatter; fail fast and count it.
 _TRANSIENT_STATUSES = frozenset({502, 503, 504})
 
+# 429 is the admission layer's EXPLICIT shed (cluster/admission.py):
+# transient by definition, but retrying before its Retry-After hint has
+# elapsed is exactly the hammering the shed exists to stop. The retry
+# policy enforces that: see retry_after_of / RetryPolicy.call.
+_SHED_STATUS = 429
+
+
+def retry_after_of(e: BaseException) -> float | None:
+    """The shed reply's ``Retry-After`` hint in seconds, or None when
+    ``e`` is not a 429 (or carries no parseable hint — the HTTP-date
+    form is treated as absent rather than guessed at). The retry policy
+    uses it as a FLOOR on the back-off delay: a shed response is never
+    re-attempted before the admitting side said a token would exist."""
+    if isinstance(e, RpcStatusError) and e.status == _SHED_STATUS:
+        return e.retry_after_s if e.retry_after_s is not None else 0.0
+    if isinstance(e, urllib.error.HTTPError) and e.code == _SHED_STATUS:
+        try:
+            return float(e.headers.get("Retry-After", ""))
+        except (TypeError, ValueError):
+            return 0.0
+    return None
+
 
 def is_retryable(e: BaseException) -> bool:
-    """Default retry classifier: transient transport failures and
-    gateway-transient statuses (502/503/504). NOT retryable:
-    application-level 4xx (the request itself is wrong — retrying cannot
-    fix it), deterministic 500s (see ``_TRANSIENT_STATUSES``), and
-    timeouts (the worker may still be processing; a retry would double
-    the caller's latency budget, the same reasoning as
+    """Default retry classifier: transient transport failures,
+    gateway-transient statuses (502/503/504), and 429 admission sheds
+    (retried only AFTER their ``Retry-After`` hint — the policy floors
+    the back-off delay at it, so internal clients and the CLI honor the
+    shed signal instead of hammering a saturated leader). NOT retryable:
+    other application-level 4xx (the request itself is wrong — retrying
+    cannot fix it), deterministic 500s (see ``_TRANSIENT_STATUSES``),
+    and timeouts (the worker may still be processing; a retry would
+    double the caller's latency budget, the same reasoning as
     ``_ScatterClient``'s single stale-connection retry).
     ``FaultInjected`` counts as transient so armed chaos faults exercise
     the retry path."""
@@ -115,9 +147,9 @@ def is_retryable(e: BaseException) -> bool:
     if isinstance(e, RpcStatusError):
         if e.deadline_exceeded:
             return False   # the caller's budget is spent; honest failure
-        return e.status in _TRANSIENT_STATUSES
+        return e.status in _TRANSIENT_STATUSES or e.status == _SHED_STATUS
     if isinstance(e, urllib.error.HTTPError):
-        return e.code in _TRANSIENT_STATUSES
+        return e.code in _TRANSIENT_STATUSES or e.code == _SHED_STATUS
     if isinstance(e, urllib.error.URLError):
         return isinstance(e.reason, _CONNECTION_ERRORS + (OSError,)) \
             and not isinstance(e.reason, socket.timeout)
@@ -129,7 +161,10 @@ def is_worker_fault(e: BaseException) -> bool:
     (count toward opening its breaker)? An application rejection (4xx,
     e.g. 415 on a binary upload) comes from a healthy worker and must not
     trip its breaker; everything else — connection failures, timeouts,
-    5xx — does."""
+    5xx — does. A 429 shed falls under the 4xx rule BY DESIGN: shedding
+    is healthy overload behavior (cluster/admission.py), and a breaker
+    that opened on sheds would amplify the very overload the shed is
+    relieving (fast-fails would mark a live node dead)."""
     if isinstance(e, RpcStatusError):
         if e.deadline_exceeded:
             return False   # honest refusal from a healthy worker
@@ -184,6 +219,13 @@ class RetryPolicy:
                 if attempt >= self.max_attempts or not classify(e):
                     raise
                 delay = self.backoff_delay(attempt)
+                shed_wait = retry_after_of(e)
+                if shed_wait is not None:
+                    # non-retryable-before-Retry-After: the shed reply's
+                    # hint FLOORS the delay — re-attempting sooner is
+                    # the hammering the 429 exists to stop
+                    delay = max(delay, shed_wait)
+                    global_metrics.inc(f"{self.name}_shed_waits")
                 if (self.deadline_s > 0
                         and self._clock() - t0 + delay > self.deadline_s):
                     raise   # the budget is spent; honest failure now

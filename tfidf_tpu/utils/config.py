@@ -277,6 +277,41 @@ class Config:
     ensemble_heartbeat_s: float = 0.25
     ensemble_commit_timeout_s: float = 5.0
 
+    # --- admission control / overload shedding (cluster/admission.py) ---
+    # Master switch for the leader's front-door admission layer
+    # (token-bucket rate limiting + queue-depth backpressure on the
+    # /leader/* endpoints). Health/metrics endpoints are never
+    # admission-controlled regardless.
+    admission_enabled: bool = True
+    # Per-client sustained admission rate (client id = X-Client-Id
+    # header, else peer IP). 0 = unlimited (backpressure still sheds).
+    admission_rate_qps: float = 0.0
+    # Token-bucket capacity (burst allowance). 0 = 2x admission_rate_qps.
+    admission_burst: float = 0.0
+    # Backpressure watermarks on the last_scatter_queue_depth gauge
+    # (queries left queued after each coalesced batch formed — the same
+    # signal the k8s HPA scales workers on): at/above high_water the
+    # BULK lane sheds; at/above critical interactive sheds too. 0
+    # disables that watermark.
+    admission_queue_high_water: int = 128
+    admission_queue_critical: int = 512
+    # Retry-After hint (seconds) on backpressure sheds (rate-limit
+    # sheds compute the honest time-to-next-token instead).
+    admission_retry_after_s: float = 0.25
+    # Bound on distinct per-client token buckets (LRU-evicted beyond).
+    admission_max_clients: int = 4096
+    # Weighted-dequeue share of each scatter batch reserved for the
+    # bulk lane while interactive traffic is queued (so neither lane
+    # can starve the other; interactive always fills first). 0 = bulk
+    # rides strictly behind interactive.
+    scatter_bulk_share: float = 0.25
+    # Leader-side query-result cache entries (LRU), keyed by the
+    # df-signature + commit-generation token so any upsert/delete/
+    # migration-flip/membership change invalidates — zipfian (skewed-
+    # popularity) traffic answers repeats without touching a worker.
+    # 0 disables the cache.
+    result_cache_entries: int = 1024
+
     # --- resilience (cluster plane) ---
     # Leader->worker RPC retry policy: bounded attempts with exponential
     # backoff + jitter; only transient failures (connection-level, 5xx)

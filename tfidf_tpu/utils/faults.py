@@ -49,6 +49,9 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
     "leader.rebalance_reconcile": "rebalancer about to trigger the "
                                   "reconcile deletes after a durable "
                                   "flip (failure retried by the sweep)",
+    "leader.admission": "front-door admission decision for one "
+                        "/leader/* request (arm to chaos-test the "
+                        "shed path itself)",
     "worker.process": "worker handling /worker/process[-batch]",
     "worker.upload": "worker handling /worker/upload[-batch]",
     "coord.heartbeat.*": "coordination server receiving a session "

@@ -33,6 +33,16 @@
 #                     ADMITTED interactive queries stays bounded, every
 #                     admitted result in exact single-node-oracle
 #                     parity (tests/test_admission.py -m slow)
+#   make chaos-partition  slow jepsen-style partition chaos job: a
+#                     concurrent upsert/delete/search workload while
+#                     the network nemesis (cluster/nemesis.py) deposes
+#                     the node leader (control-plane cut, data plane
+#                     intact — the split-brain fence case), splits the
+#                     3-member coordinator ensemble, one-way-isolates
+#                     a worker, and flaps the full mesh; after heal:
+#                     exact single-node-oracle parity, zero acked-write
+#                     loss, zero stale-epoch writes accepted
+#                     (tests/test_partition.py -m slow)
 #   make faults       list every registered fault point (chaos configs
 #                     should be validated against this — see
 #                     utils/faults.py)
@@ -58,8 +68,8 @@
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
-        chaos-overload faults bench bench-overload probe-overlap \
-        graftcheck lockdep check
+        chaos-overload chaos-partition faults bench bench-overload \
+        probe-overlap graftcheck lockdep check
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -78,7 +88,8 @@ lockdep:
 	JAX_PLATFORMS=cpu GRAFTCHECK_LOCKDEP=1 python -m pytest \
 	  tests/test_resilience.py tests/test_cluster.py \
 	  tests/test_replication.py tests/test_rebalance.py \
-	  tests/test_admission.py tests/test_graftcheck.py \
+	  tests/test_admission.py tests/test_partition.py \
+	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 check: graftcheck test
@@ -97,6 +108,9 @@ chaos-rebalance:
 
 chaos-overload:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_admission.py $(PYTEST_FLAGS) -m slow
+
+chaos-partition:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_partition.py $(PYTEST_FLAGS) -m slow
 
 faults:
 	python -m tfidf_tpu faults list

@@ -29,7 +29,7 @@ import pytest
 
 from tools.graftcheck import core as gc_core
 from tools.graftcheck import (jitpurity, lockgraph, registry_drift,
-                              resilience)
+                              resilience, wallclock)
 from tools.graftcheck.core import (SourceTree, load_allowlist,
                                    load_baseline, run_analyzers, triage)
 from tools.graftcheck.witness import LockdepWitness, _InstrLock
@@ -286,6 +286,33 @@ class Node:
         found = resilience.analyze(tree)
         quals = {f.key.split(":")[2] for f in found}
         assert "cluster.rpc.kw_wrapped.rpc" not in quals, quals
+
+    def test_detects_wallclock_misuse(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"mod.py": '''
+import time
+
+def f():
+    deadline = time.time() + 5
+    return deadline
+
+def g():
+    while time.time() < 9:
+        pass
+
+def h():
+    return {"created_at": time.time()}
+
+def ok():
+    return time.monotonic() - 1
+'''})
+        keys = {f.key for f in wallclock.analyze(tree)}
+        # direct arithmetic/comparison AND taint-through-a-local both
+        # classify as deadline arithmetic; a bare read is a timestamp
+        assert "wallclock:mod.f:deadline-arithmetic" in keys
+        assert "wallclock:mod.g:deadline-arithmetic" in keys
+        assert "wallclock:mod.h:timestamp" in keys
+        # time.monotonic is the prescribed fix — never flagged
+        assert not any("mod.ok" in k for k in keys)
 
 
 # ---------------------------------------------------------------------------

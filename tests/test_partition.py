@@ -1,0 +1,1189 @@
+"""Partition-tolerant correctness: leadership fencing + network nemesis.
+
+The acceptance story (ISSUE 9): a deposed-but-alive leader — cut from
+the coordinator but NOT from the workers, the split-brain the
+crash-only chaos suites cannot reach — can no longer land a single
+write on any shard: every mutating RPC carries a monotonic leadership
+epoch (the election znode's own sequence number), workers durably
+remember the highest epoch ever seen and 403-fence anything lower, and
+a fenced leader steps down instead of retrying. A network-level
+nemesis (``cluster/nemesis.py``) scripts the partitions at the shared
+HTTP seams — no monkeypatching — and the healed cluster converges to
+exact single-node-oracle parity with zero acked-write loss and zero
+stale-epoch writes accepted.
+
+Tier-1 (deterministic): nemesis mechanics, epoch derivation, the
+worker fence (incl. restart persistence — a rebooted worker cannot be
+captured by a stale leader), the non-retryable/never-worker-fault
+classification, stale-write rejection + leader step-down, data-plane
+partition heal to exact parity, reply-corruption tolerance, the
+gray-failure latency breaker, and jittered reconnect backoff.
+
+Slow (``make chaos-partition``): the jepsen-style schedule — a
+concurrent upsert/delete/search workload while the nemesis deposes the
+node leader, splits the 3-member coordinator ensemble, one-way
+isolates a worker, and flaps the full mesh; heal, converge, verify.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tfidf_tpu.cluster.coordination import (CoordinationClient,
+                                            CoordinationCore,
+                                            CoordinationServer,
+                                            LocalCoordination)
+from tfidf_tpu.cluster.election import LeaderElection
+from tfidf_tpu.cluster.fencing import FenceGuard
+from tfidf_tpu.cluster.nemesis import (NemesisPartitioned,
+                                       NemesisReplyLost, NemesisNet,
+                                       endpoint_of, global_nemesis)
+from tfidf_tpu.cluster.node import SearchNode, http_post
+from tfidf_tpu.cluster.resilience import (ClusterResilience,
+                                          CircuitOpenError,
+                                          RpcStatusError,
+                                          is_fence_rejection,
+                                          is_retryable, is_worker_fault)
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.metrics import global_metrics
+
+from tests.test_cluster import wait_until
+
+
+@pytest.fixture(autouse=True)
+def _heal_nemesis():
+    """Every test leaves the (process-global) network healed."""
+    yield
+    global_nemesis.heal()
+
+
+@pytest.fixture
+def core():
+    c = CoordinationCore(session_timeout_s=0.5)
+    yield c
+    c.close()
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+DOCS = {f"pt{i}.txt": f"common token{i} word{i % 3} extra{i % 5}"
+        for i in range(10)}
+QUERIES = ["common", "token3 word0", "word1 extra2", "common token7"]
+
+_CFG = dict(
+    top_k=32, min_doc_capacity=64, min_nnz_capacity=1 << 12,
+    min_vocab_capacity=1 << 10, query_batch=8, max_query_terms=8,
+    rpc_max_attempts=1,            # deterministic: no hidden retries
+    breaker_failure_threshold=2, breaker_reset_s=0.4,
+    reconcile_sweep_interval_s=0.2, placement_flush_ms=10.0,
+    replication_factor=2,
+    # scatter mechanics are under test; a leader-side cache hit would
+    # answer without any fan-out and mask them
+    result_cache_entries=0)
+
+
+def _node(core, tmp_path, i, **kw):
+    cfg_kw = dict(_CFG)
+    cfg_kw.update(kw)
+    cfg = Config(
+        documents_path=str(tmp_path / f"pt{i}" / "documents"),
+        index_path=str(tmp_path / f"pt{i}" / "index"),
+        port=0, **cfg_kw)
+    return SearchNode(cfg, coord=LocalCoordination(core, 0.1)).start()
+
+
+def _mk_cluster(core, tmp_path, n=3, **kw):
+    nodes = [_node(core, tmp_path, i, **kw) for i in range(n)]
+    wait_until(lambda: len(
+        nodes[0].registry.get_all_service_addresses()) == n - 1)
+    return nodes
+
+
+def _stop_all(nodes):
+    for nd in nodes:
+        try:
+            nd.stop()
+        except Exception:
+            pass
+
+
+def _upload_docs(leader_url, docs=DOCS):
+    batch = [{"name": n, "text": t} for n, t in docs.items()]
+    return json.loads(http_post(leader_url + "/leader/upload-batch",
+                                json.dumps(batch).encode()))
+
+
+def _search(leader_url, q):
+    return json.loads(http_post(
+        leader_url + "/leader/start", json.dumps({"query": q}).encode()))
+
+
+def _oracle(tmp_path, docs, queries, **cfg_kw):
+    """Single-node oracle over the FULL corpus. With full replication
+    (every registered worker holds every doc) per-shard statistics
+    equal the oracle's, so distributed merge parity is EXACT."""
+    kw = {k: v for k, v in _CFG.items()
+          if k in ("top_k", "min_doc_capacity", "min_nnz_capacity",
+                   "min_vocab_capacity", "query_batch",
+                   "max_query_terms")}
+    kw.update(cfg_kw)
+    cfg = Config(documents_path=str(tmp_path / "oracle" / "documents"),
+                 index_path=str(tmp_path / "oracle" / "index"), **kw)
+    eng = Engine(cfg)
+    for name, text in docs.items():
+        eng.ingest_bytes(name, text.encode(), save_to_disk=False)
+    eng.commit()
+    out = {}
+    for q in queries:
+        hits = eng.search(q)
+        merged = {h.name: h.score for h in hits}
+        out[q] = dict(sorted(merged.items(),
+                             key=lambda kv: (-kv[1], kv[0]))
+                      [:cfg.top_k])
+    return out
+
+
+def _parity(got: dict, want: dict) -> bool:
+    if set(got) != set(want):
+        return False
+    return all(abs(got[k] - want[k]) < 1e-4 for k in got)
+
+
+# ---------------------------------------------------------------------------
+# NemesisNet mechanics (pure)
+# ---------------------------------------------------------------------------
+
+class TestNemesisNet:
+    def test_inactive_is_passthrough(self):
+        net = NemesisNet()
+        net.check_send("a:1", "b:2")
+        assert net.filter_reply("a:1", "b:2", b"xyz") == b"xyz"
+        assert not net.active()
+
+    def test_endpoint_normalization(self):
+        assert endpoint_of("http://127.0.0.1:8085/") == "127.0.0.1:8085"
+        assert endpoint_of("127.0.0.1:2181") == "127.0.0.1:2181"
+        assert endpoint_of(None) == ""
+
+    def test_symmetric_partition_both_ways(self):
+        net = NemesisNet()
+        net.partition(["http://h:1"], ["h:2"])
+        with pytest.raises(NemesisPartitioned):
+            net.check_send("h:1", "h:2")
+        with pytest.raises(NemesisPartitioned):
+            net.check_send("h:2", "http://h:1")
+        net.check_send("h:1", "h:3")          # unrelated link flows
+        net.heal()
+        net.check_send("h:1", "h:2")
+
+    def test_one_way_drop(self):
+        net = NemesisNet()
+        net.one_way("h:1", "h:2")
+        with pytest.raises(NemesisPartitioned):
+            net.check_send("h:1", "h:2")
+        net.check_send("h:2", "h:1")          # reverse direction flows
+
+    def test_isolate_keeps_internal_and_self_links(self):
+        net = NemesisNet()
+        net.isolate(["h:1", "h:2"])
+        with pytest.raises(NemesisPartitioned):
+            net.check_send("h:1", "h:3")
+        with pytest.raises(NemesisPartitioned):
+            net.check_send("h:3", "h:2")
+        net.check_send("h:1", "h:2")          # within the minority
+        net.check_send("h:1", "h:1")          # loopback exempt
+        net.check_send("h:3", "h:4")          # majority side untouched
+
+    def test_unknown_origin_matches_only_wildcard_src(self):
+        net = NemesisNet()
+        net.drop(src=["h:1"], dst=["h:2"])
+        net.check_send(None, "h:2")           # unknown src: not h:1
+        net.drop(dst=["h:9"])                 # wildcard src
+        with pytest.raises(NemesisPartitioned):
+            net.check_send(None, "h:9")
+
+    def test_delay_sleeps_and_counts(self):
+        slept = []
+        net = NemesisNet(sleep=slept.append)
+        net.delay(src=["h:1"], dst=["h:2"], delay_s=0.05)
+        before = global_metrics.get("nemesis_delays", 0)
+        net.check_send("h:1", "h:2")
+        assert slept and abs(slept[0] - 0.05) < 1e-9
+        assert global_metrics.get("nemesis_delays") == before + 1
+
+    def test_reply_drop_truncate_corrupt(self):
+        net = NemesisNet()
+        rid = net.drop_reply(dst=["h:2"])
+        with pytest.raises(NemesisReplyLost):
+            net.filter_reply("h:1", "h:2", b"reply")
+        net.remove(rid)
+        net.truncate(dst=["h:2"], keep_bytes=3)
+        assert net.filter_reply("h:1", "h:2", b"longreply") == b"lon"
+        net.heal()
+        net.corrupt(dst=["h:2"])
+        out = net.filter_reply("h:1", "h:2", b"abcd")
+        assert out != b"abcd" and len(out) == 4
+
+
+# ---------------------------------------------------------------------------
+# Leadership epochs + the worker fence
+# ---------------------------------------------------------------------------
+
+class TestLeadershipEpoch:
+    def test_epoch_is_znode_sequence_and_monotonic(self, core):
+        class Cb:
+            def on_elected_to_be_leader(self):
+                pass
+
+            def on_worker(self):
+                pass
+
+        c1 = LocalCoordination(core, 0.1)
+        c2 = LocalCoordination(core, 0.1)
+        e1 = LeaderElection(c1, Cb())
+        e2 = LeaderElection(c2, Cb())
+        e1.volunteer_for_leadership()
+        e2.volunteer_for_leadership()
+        assert e1.epoch() is not None and e2.epoch() is not None
+        assert e2.epoch() > e1.epoch()
+        # the old leader resigns and re-volunteers: its NEW epoch
+        # outranks everything it ever held and everything live
+        old = e1.epoch()
+        e1.resign()
+        assert e1.epoch() is None
+        e1.volunteer_for_leadership()
+        assert e1.epoch() > e2.epoch() > old
+        c1.close()
+        c2.close()
+
+
+class TestFenceGuard:
+    def test_accepts_equal_higher_rejects_lower(self, tmp_path):
+        g = FenceGuard(str(tmp_path / "f.json"))
+        assert g.current() == -1
+        assert g.observe(5)
+        assert g.observe(5)           # equal epoch: same leader again
+        assert g.observe(7)
+        assert not g.observe(6)
+        assert g.current() == 7
+
+    def test_persists_across_restart(self, tmp_path):
+        path = str(tmp_path / "f.json")
+        FenceGuard(path).observe(9)
+        g2 = FenceGuard(path)         # the rebooted worker
+        assert g2.current() == 9
+        assert not g2.observe(8)
+
+    def test_unreadable_state_starts_fresh(self, tmp_path):
+        path = tmp_path / "f.json"
+        path.write_text("not json at all")
+        g = FenceGuard(str(path))
+        assert g.current() == -1
+        assert g.observe(0)
+
+
+class TestFenceClassification:
+    def test_rpc_status_error_fenced(self):
+        e = RpcStatusError("http://w", 403, fenced=True)
+        assert is_fence_rejection(e)
+        assert not is_retryable(e)
+        assert not is_worker_fault(e)
+        # a PLAIN 403 (no fence marker) is an app rejection, not a fence
+        assert not is_fence_rejection(RpcStatusError("http://w", 403))
+
+    def test_http_error_fenced_by_header(self):
+        import email.message
+        h = email.message.Message()
+        h["X-Fence-Rejected"] = "1"
+        e = urllib.error.HTTPError("http://w", 403, "fenced", h, None)
+        assert is_fence_rejection(e)
+        assert not is_retryable(e)
+        assert not is_worker_fault(e)
+
+    def test_fence_rejection_never_trips_breaker(self):
+        cr = ClusterResilience(Config(rpc_max_attempts=1,
+                                      breaker_failure_threshold=1))
+
+        def fenced():
+            raise RpcStatusError("http://w", 403, fenced=True)
+
+        for _ in range(3):
+            with pytest.raises(RpcStatusError):
+                cr.worker_call("http://w", fenced)
+        assert cr.board.breaker("http://w").state == "closed"
+
+
+class TestWorkerFenceEndpoint:
+    def _post(self, url, body, epoch=None):
+        h = {"X-Leader-Epoch": str(epoch)} if epoch is not None else {}
+        return http_post(url, body, headers=h)
+
+    def test_fence_on_mutating_endpoints(self, core, tmp_path):
+        node = _node(core, tmp_path, 0)
+        try:
+            base = node.url
+            # the single node elected itself: its own epoch is already
+            # observed — a strictly higher client epoch advances it
+            self._post(base + "/worker/upload?name=a.txt", b"alpha beta",
+                       epoch=50)
+            assert node.fence.current() == 50
+            # unstamped requests (reference clients) are never fenced
+            self._post(base + "/worker/upload?name=b.txt", b"gamma")
+            # every mutating endpoint rejects a lower epoch with the
+            # distinct fence status + headers
+            for url, body in (
+                    (base + "/worker/upload?name=c.txt", b"delta"),
+                    (base + "/worker/upload-batch",
+                     json.dumps([{"name": "d.txt", "text": "x"}]).encode()),
+                    (base + "/worker/delete",
+                     json.dumps({"names": ["a.txt"]}).encode())):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._post(url, body, epoch=49)
+                assert ei.value.code == 403
+                assert ei.value.headers.get("X-Fence-Rejected") == "1"
+                assert ei.value.headers.get("X-Fence-Epoch") == "50"
+            assert global_metrics.get("fence_rejections") >= 3
+            # the fenced delete did NOT delete: the doc still scores
+            hits = json.loads(http_post(base + "/worker/process",
+                                        b"alpha"))
+            assert any(h["document"]["name"] == "a.txt" for h in hits)
+        finally:
+            node.stop()
+
+    def test_restart_reloads_epoch_cannot_be_captured(self, core,
+                                                      tmp_path):
+        """Satellite: a worker that reboots mid-partition reloads its
+        highest-seen epoch — a stale leader cannot capture it."""
+        node = _node(core, tmp_path, 0)
+        base = node.url
+        self._post(base + "/worker/upload?name=a.txt", b"alpha",
+                   epoch=50)
+        node.stop()
+        core2 = CoordinationCore(session_timeout_s=0.5)
+        try:
+            node2 = _node(core2, tmp_path, 0)   # same index_path
+            try:
+                assert node2.fence.current() == 50
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._post(node2.url + "/worker/upload?name=z.txt",
+                               b"stale", epoch=49)
+                assert ei.value.code == 403
+            finally:
+                node2.stop()
+        finally:
+            core2.close()
+
+
+class TestStaleLeaderStepDown:
+    @pytest.mark.timeout(60)
+    def test_stale_write_rejected_and_leader_steps_down(self, core,
+                                                        tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            assert leader.is_leader()
+            epoch = leader.election.epoch()
+            workers = leader.registry.get_all_service_addresses()
+            assert len(workers) == 2
+            # a newer leader exists somewhere: its first mutating RPC
+            # advanced every worker's fence past ours (injected via an
+            # empty, epoch-stamped delete — a no-op write)
+            for w in workers:
+                http_post(w + "/worker/delete",
+                          json.dumps({"names": []}).encode(),
+                          headers={"X-Leader-Epoch": str(epoch + 1)})
+            # the stale leader's write is rejected on every leg and is
+            # NEVER acked
+            with pytest.raises(Exception):
+                leader.leader_upload("stale.txt", b"stale write")
+            assert global_metrics.get("fence_rejections") >= 2
+            assert global_metrics.get("fence_step_downs") >= 1
+            # ... and the deposed leader steps down: another node takes
+            # over, the ex-leader drops its epoch + placement authority
+            assert wait_until(lambda: any(n.is_leader()
+                                          for n in nodes[1:]), timeout=15)
+            assert wait_until(lambda: not nodes[0].is_leader(),
+                              timeout=10)
+            assert nodes[0]._leader_epoch is None
+            # the successor (higher epoch by construction) writes fine
+            new = next(n for n in nodes[1:] if n.is_leader())
+            resp = _upload_docs(new.url, {"ok.txt": "accepted write"})
+            assert not resp.get("failed")
+            assert wait_until(
+                lambda: "ok.txt" in _search(new.url, "accepted"),
+                timeout=10)
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Split-brain under a REAL control-plane partition (the acceptance case)
+# ---------------------------------------------------------------------------
+
+class TestSplitBrainPartition:
+    @pytest.mark.timeout(120)
+    def test_deposed_leader_fenced_heals_to_parity(self, tmp_path):
+        """The leader-minority schedule: the node leader is cut from
+        the coordinator (data plane intact — the dangerous half of a
+        partition), a new leader is elected and fences the workers
+        forward, the deposed leader's write is rejected everywhere and
+        it steps down; after heal the cluster converges to exact
+        single-node-oracle parity with zero acked-write loss, zero
+        stale-epoch writes accepted, and fence_rejections > 0."""
+        srv = CoordinationServer(session_timeout_s=0.6).start()
+        nodes = []
+        try:
+            def factory():
+                return CoordinationClient(srv.address,
+                                          heartbeat_interval_s=0.1,
+                                          failover_deadline_s=1.0)
+
+            for i in range(3):
+                cfg = Config(
+                    documents_path=str(tmp_path / f"sb{i}" / "documents"),
+                    index_path=str(tmp_path / f"sb{i}" / "index"),
+                    port=0, session_timeout_s=0.6, **_CFG)
+                nodes.append(SearchNode(cfg, coord=factory(),
+                                        coord_factory=factory).start())
+            old = nodes[0]
+            assert wait_until(lambda: old.is_leader(), timeout=10)
+            assert wait_until(lambda: len(
+                old.registry.get_all_service_addresses()) == 2,
+                timeout=10)
+            acked = dict(DOCS)
+            resp = _upload_docs(old.url)
+            assert not resp.get("failed")
+            # wait for the DURABLE map to cover every acked doc before
+            # partitioning: acked-but-unflushed placements are the
+            # known debounce-window residual, not what this test pins
+            from tfidf_tpu.cluster.placement import PLACEMENT_STATE
+            probe = factory()
+
+            def persisted_all():
+                try:
+                    raw = probe.get_data(PLACEMENT_STATE)
+                    reps = json.loads(raw.decode()).get("replicas", {})
+                    return set(DOCS) <= set(reps)
+                except Exception:
+                    return False
+
+            assert wait_until(persisted_all, timeout=10)
+            probe.close()
+
+            # --- the partition: old leader <-> coordinator only ---
+            global_nemesis.partition([old.url], [srv.address])
+            new = None
+
+            def new_leader():
+                nonlocal new
+                for n in nodes[1:]:
+                    try:
+                        if n.is_leader():
+                            new = n
+                            return True
+                    except Exception:
+                        pass
+                return False
+
+            assert wait_until(new_leader, timeout=20)
+            # the new leader's first mutating RPC fences the surviving
+            # worker forward
+            resp = _upload_docs(new.url, {"epoch.txt": "epochal write"})
+            assert not resp.get("failed")
+            acked["epoch.txt"] = "epochal write"
+
+            # --- the split-brain write through the DEPOSED leader ---
+            with pytest.raises(urllib.error.HTTPError):
+                http_post(old.url + "/leader/upload?name=stale.txt",
+                          b"stalebrain token")
+            assert global_metrics.get("fence_rejections") >= 1
+            assert global_metrics.get("fence_step_downs") >= 1
+            assert wait_until(lambda: old._role == "worker", timeout=10)
+
+            # --- heal; the ex-leader rejoins as a worker ---
+            global_nemesis.heal()
+            t_heal = time.monotonic()
+            assert wait_until(lambda: len(
+                new.registry.get_all_service_addresses()) == 2,
+                timeout=30)
+            resp = _upload_docs(new.url, {"after.txt": "post heal doc"})
+            assert not resp.get("failed")
+            acked["after.txt"] = "post heal doc"
+
+            queries = QUERIES + ["epochal", "post heal", "stalebrain"]
+            want = _oracle(tmp_path, acked, queries)
+
+            def parity():
+                try:
+                    return all(_parity(_search(new.url, q), want[q])
+                               for q in queries)
+                except Exception:
+                    return False
+
+            assert wait_until(parity, timeout=40, interval=0.25), {
+                q: (_search(new.url, q), want[q]) for q in queries}
+            recovery_s = time.monotonic() - t_heal
+            # zero stale-epoch writes accepted: the split-brain doc is
+            # nowhere (its unique token matches nothing)
+            assert _search(new.url, "stalebrain") == {}
+            print(f"\nhealed-partition recovery to exact parity: "
+                  f"{recovery_s:.2f}s")
+        finally:
+            _stop_all(nodes)
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Data-plane partition + reply corruption: heal to exact parity
+# ---------------------------------------------------------------------------
+
+class TestPartitionHealParity:
+    @pytest.mark.timeout(90)
+    def test_data_plane_partition_heals_to_parity(self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            resp = _upload_docs(leader.url)
+            assert not resp.get("failed")
+            want = _oracle(tmp_path, DOCS, QUERIES)
+            assert wait_until(lambda: all(
+                _parity(_search(leader.url, q), want[q])
+                for q in QUERIES), timeout=15)
+
+            workers = leader.registry.get_all_service_addresses()
+            global_nemesis.partition([leader.url], workers)
+            # partitioned searches fail loudly-but-bounded (degraded,
+            # possibly empty) and partitioned uploads are NEVER acked
+            with pytest.raises(Exception):
+                json.loads(http_post(
+                    leader.url + "/leader/upload?name=lost.txt",
+                    b"lost write"))
+            assert global_metrics.get("nemesis_drops") > 0
+
+            global_nemesis.heal()
+            t_heal = time.monotonic()
+            assert wait_until(lambda: all(
+                _parity(_search(leader.url, q), want[q])
+                for q in QUERIES), timeout=20, interval=0.2)
+            print(f"\ndata-plane partition heal to parity: "
+                  f"{time.monotonic() - t_heal:.2f}s")
+            # the never-acked write is nowhere
+            assert _search(leader.url, "lost") == {}
+        finally:
+            _stop_all(nodes)
+
+    @pytest.mark.timeout(90)
+    def test_reply_corruption_tolerated_exactly(self, core, tmp_path):
+        """Truncated/corrupted replies from one worker fail wire
+        validation (ValueError) and fail over to the intact replica —
+        results stay EXACT with full replication."""
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            resp = _upload_docs(leader.url)
+            assert not resp.get("failed")
+            want = _oracle(tmp_path, DOCS, QUERIES)
+            assert wait_until(lambda: all(
+                _parity(_search(leader.url, q), want[q])
+                for q in QUERIES), timeout=15)
+            victim = leader.registry.get_all_service_addresses()[0]
+            global_nemesis.truncate(src=[leader.url], dst=[victim],
+                                    keep_bytes=6)
+            for q in QUERIES:
+                assert _parity(_search(leader.url, q), want[q]), q
+            assert global_metrics.get("nemesis_corruptions") > 0
+            assert global_metrics.get("scatter_failures") > 0
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Gray failures: slow-but-alive workers trip the breaker
+# ---------------------------------------------------------------------------
+
+class TestGrayFailure:
+    def test_latency_ewma_trips_and_probe_readmits(self):
+        cfg = Config(rpc_max_attempts=1, breaker_failure_threshold=5,
+                     breaker_reset_s=0.2, breaker_slow_threshold_ms=30,
+                     breaker_slow_min_samples=3)
+        cr = ClusterResilience(cfg)
+
+        def slow():
+            time.sleep(0.04)
+            return "ok"
+
+        for _ in range(3):
+            assert cr.worker_call("http://w", slow,
+                                  track_latency=True) == "ok"
+        assert global_metrics.get("breaker_slow_trips") == 1
+        with pytest.raises(CircuitOpenError):
+            cr.worker_call("http://w", slow, track_latency=True)
+        time.sleep(0.25)
+        # half-open probe: a FAST call closes the breaker; the EWMA
+        # restarted on trip, so the slow era cannot re-condemn it
+        assert cr.worker_call("http://w", lambda: "fast",
+                              track_latency=True) == "fast"
+        assert cr.board.breaker("http://w").state == "closed"
+        assert global_metrics.get("breaker_slow_trips") == 1
+
+    def test_disabled_by_default(self):
+        cr = ClusterResilience(Config(rpc_max_attempts=1))
+        for _ in range(10):
+            cr.worker_call("http://w", lambda: time.sleep(0.02),
+                           track_latency=True)
+        assert global_metrics.get("breaker_slow_trips") == 0
+
+    @pytest.mark.timeout(90)
+    def test_nemesis_latency_trips_slow_breaker_results_exact(
+            self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3,
+                            breaker_slow_threshold_ms=40,
+                            breaker_slow_min_samples=2,
+                            breaker_reset_s=5.0)
+        try:
+            leader = nodes[0]
+            resp = _upload_docs(leader.url)
+            assert not resp.get("failed")
+            want = _oracle(tmp_path, DOCS, QUERIES)
+            assert wait_until(lambda: all(
+                _parity(_search(leader.url, q), want[q])
+                for q in QUERIES), timeout=15)
+            victim = leader.registry.get_all_service_addresses()[0]
+            global_nemesis.delay(src=[leader.url], dst=[victim],
+                                 delay_s=0.08)
+            # a few searches feed the EWMA; the slow worker trips and
+            # its ownership slice fails over — results stay exact
+            for _ in range(4):
+                for q in QUERIES:
+                    assert _parity(_search(leader.url, q), want[q]), q
+            assert global_metrics.get("breaker_slow_trips") >= 1
+            assert global_metrics.get("nemesis_delays") > 0
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Reconnect storms: jittered backoff on the coordination client
+# ---------------------------------------------------------------------------
+
+class TestReconnectJitter:
+    def test_backoff_delays_jittered_bounded_and_distinct(self):
+        srv = CoordinationServer(session_timeout_s=10.0).start()
+        try:
+            c1 = CoordinationClient(srv.address, heartbeat_interval_s=5.0)
+            c2 = CoordinationClient(srv.address, heartbeat_interval_s=5.0)
+            try:
+                a = [c1._reconnect.backoff_delay(3) for _ in range(10)]
+                b = [c2._reconnect.backoff_delay(3) for _ in range(10)]
+                # exponential base at attempt 3 = 0.05 * 4 = 0.2, ±25%
+                for d in a + b:
+                    assert 0.14 <= d <= 0.26
+                # jitter: the sequences are not constant and the two
+                # clients' phases are decorrelated
+                assert len(set(a + b)) > 5
+            finally:
+                c1.close()
+                c2.close()
+        finally:
+            srv.close()
+
+    @pytest.mark.timeout(60)
+    def test_flap_reconnects_spread_not_herd(self):
+        """Nemesis flap: N partitioned clients accumulate jittered
+        backoff sleeps (no fixed 20 Hz beat), and all recover after
+        heal."""
+        srv = CoordinationServer(session_timeout_s=30.0).start()
+        clients = []
+        recorded = {}
+        try:
+            for i in range(4):
+                c = CoordinationClient(srv.address,
+                                       heartbeat_interval_s=5.0,
+                                       failover_deadline_s=0.6,
+                                       origin=f"cl{i}:0")
+                sleeps = recorded[i] = []
+
+                def rec(d, _sleeps=sleeps):
+                    _sleeps.append(d)
+                    time.sleep(min(d, 0.02))   # keep the test fast
+
+                c._reconnect._sleep = rec
+                clients.append(c)
+            global_nemesis.drop(src=[f"cl{i}:0" for i in range(4)],
+                                dst=[srv.address])
+
+            def hammer(c):
+                try:
+                    c.exists("/flap")
+                except Exception:
+                    pass
+
+            threads = [threading.Thread(target=hammer, args=(c,))
+                       for c in clients]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            global_nemesis.heal()
+            for c in clients:
+                assert c.exists("/flap") is False   # recovered
+            for i in range(4):
+                assert recorded[i], f"client {i} never backed off"
+            # the union of chosen delays is spread, not one fixed beat
+            assert len({round(d, 4) for ds in recorded.values()
+                        for d in ds}) >= 5
+            assert global_metrics.get("coord_reconnect_backoffs") > 0
+        finally:
+            for c in clients:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Placement residue machinery (ghosts / orphans / blanket deletes)
+# ---------------------------------------------------------------------------
+
+class TestResidueMachinery:
+    def _mapped(self, pm, name, workers):
+        with pm.lock:
+            pm.replicas[name] = tuple(workers)
+            pm._confirmed[name] = set(workers)
+
+    def test_forget_blanket_schedules_every_live_worker(self):
+        from tfidf_tpu.cluster.placement import PlacementMap
+        pm = PlacementMap(flush_ms=-1)
+        self._mapped(pm, "d1", ["w1", "w2"])
+        out = pm.forget(["d1"], also={"w1", "w2", "w3"})
+        # confirmed holders AND the ghost-hunting blanket (w3)
+        assert set(out) == {"w1", "w2", "w3"}
+        assert pm.holders_of("d1") == ()
+        assert all("d1" in ns for ns in pm.pending_moved().values())
+
+    def test_reconcile_residue_ghost_and_orphan(self):
+        from tfidf_tpu.cluster.placement import PlacementMap
+        pm = PlacementMap(flush_ms=-1)
+        self._mapped(pm, "mapped.txt", ["w1"])
+        ghosts, orphans = pm.reconcile_residue(
+            "w2", ["mapped.txt", "orphan.txt"], protected=set())
+        # w2's copy of a doc mapped to w1 is a ghost: scheduled away
+        assert ghosts == ["mapped.txt"]
+        assert "mapped.txt" in pm.pending_moved().get("w2", ())
+        # a doc mapped nowhere is adopted as a confirmed replica
+        assert orphans == ["orphan.txt"]
+        assert pm.holders_of("orphan.txt") == ("w2",)
+        # deleted-doc residue on a late-coming worker is a ghost, not
+        # an adoption (pending deletion anywhere blocks adoption)
+        self._mapped(pm, "del.txt", ["w1"])
+        pm.forget(["del.txt"], also={"w1"})
+        g2, o2 = pm.reconcile_residue("w2", ["del.txt"],
+                                      protected=set())
+        assert g2 == ["del.txt"] and not o2
+
+    def test_reconcile_residue_skips_inflight_and_protected(self):
+        from tfidf_tpu.cluster.placement import PlacementMap
+        pm = PlacementMap(flush_ms=-1)
+        with pm.lock:
+            pm.route_locked("up.txt", ["w1"], {"w1": 0}, None, 1)
+        g, o = pm.reconcile_residue(
+            "w2", ["up.txt", "mig.txt"], protected={"mig.txt"})
+        assert not g and not o   # in-flight legs + migrations are
+        # owned by their own machinery
+
+    def test_add_replica_refuses_deleted_and_stray_is_scheduled(self):
+        from tfidf_tpu.cluster.placement import PlacementMap
+        pm = PlacementMap(flush_ms=-1)
+        assert pm.add_replica("gone.txt", "w1") is False
+        pm.note_stray("gone.txt", "w1")
+        assert "gone.txt" in pm.pending_moved().get("w1", ())
+        self._mapped(pm, "live.txt", ["w1"])
+        assert pm.add_replica("live.txt", "w2") is True
+        assert pm.holders_of("live.txt") == ("w1", "w2")
+
+    def test_unplaced_of(self):
+        from tfidf_tpu.cluster.placement import PlacementMap
+        pm = PlacementMap(flush_ms=-1)
+        self._mapped(pm, "mapped.txt", ["w1"])
+        pm.forget(["mapped.txt"], also={"w1"})   # pending delete
+        self._mapped(pm, "held.txt", ["w1"])
+        got = pm.unplaced_of(
+            ["mapped.txt", "held.txt", "lost.txt", "mig.txt"],
+            protected={"mig.txt"})
+        assert got == ["lost.txt"]
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide delete (the workload's delete leg)
+# ---------------------------------------------------------------------------
+
+class TestLeaderDelete:
+    @pytest.mark.timeout(90)
+    def test_delete_removes_everywhere_and_is_durable(self, core,
+                                                      tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            resp = _upload_docs(leader.url)
+            assert not resp.get("failed")
+            assert wait_until(
+                lambda: "pt3.txt" in _search(leader.url, "token3"),
+                timeout=15)
+            out = json.loads(http_post(
+                leader.url + "/leader/delete",
+                json.dumps({"names": ["pt3.txt"]}).encode()))
+            assert out["forgotten"] == 1
+            # gone from results immediately and stays gone
+            assert "pt3.txt" not in _search(leader.url, "token3")
+            remaining = {n: t for n, t in DOCS.items() if n != "pt3.txt"}
+            want = _oracle(tmp_path, remaining, QUERIES)
+            assert wait_until(lambda: all(
+                _parity(_search(leader.url, q), want[q])
+                for q in QUERIES), timeout=20, interval=0.2)
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# The jepsen-style chaos schedule (slow; make chaos-partition)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestChaosPartition:
+    @pytest.mark.timeout(420)
+    def test_jepsen_schedule_converges_exactly(self, tmp_path):
+        """Concurrent upsert/delete/search workload while the nemesis
+        (1) deposes the node leader (control-plane cut), (2) splits
+        the 3-member coordinator ensemble, (3) one-way isolates a
+        worker, and (4) flaps the full mesh — then heals and asserts
+        exact single-node-oracle parity, zero acked-write loss, zero
+        stale-epoch writes accepted."""
+        ports = free_ports(3)
+        peers = {f"c{i}": f"127.0.0.1:{p}" for i, p in enumerate(ports)}
+        servers = {}
+        for i, p in enumerate(ports):
+            servers[f"c{i}"] = CoordinationServer(
+                host="127.0.0.1", port=p, session_timeout_s=1.0,
+                data_dir=str(tmp_path / f"c{i}"), node_id=f"c{i}",
+                peers=dict(peers), election_timeout_s=0.4,
+                heartbeat_interval_s=0.1, commit_timeout_s=3.0,
+                snapshot_every=128).start()
+        connect = ",".join(peers.values())
+        nodes = []
+        stop_flag = threading.Event()
+        lock = threading.Lock()
+        acked: dict[str, str] = {}      # name -> text (200-acked state)
+        ambiguous: set[str] = set()     # failed ops: either outcome ok
+        deleted: set[str] = set()
+        try:
+            def factory():
+                return CoordinationClient(connect,
+                                          heartbeat_interval_s=0.2,
+                                          failover_deadline_s=2.0)
+
+            for i in range(3):
+                cfg = Config(
+                    documents_path=str(tmp_path / f"ch{i}" / "documents"),
+                    index_path=str(tmp_path / f"ch{i}" / "index"),
+                    port=0, session_timeout_s=1.0, **{
+                        **_CFG, "replication_factor": 3,
+                        "rpc_max_attempts": 2,
+                        "residue_sweep_ms": 1000.0})
+                nodes.append(SearchNode(cfg, coord=factory(),
+                                        coord_factory=factory).start())
+            assert wait_until(lambda: nodes[0].is_leader(), timeout=20)
+            assert wait_until(lambda: len(
+                nodes[0].registry.get_all_service_addresses()) == 2,
+                timeout=20)
+
+            def leader_url():
+                for n in nodes:
+                    if n._role == "leader":
+                        return n.url
+                return nodes[0].url
+
+            # during schedule 1 a deposed-but-undemoted leader can
+            # still ACK writes whose placement never reaches the
+            # durable map (the known debounce residual) — the fence
+            # stops the post-promotion half; the workload quiesces its
+            # WRITES for that window (searches keep running) so the
+            # final oracle comparison stays exact
+            writes_ok = threading.Event()
+            writes_ok.set()
+
+            def workload(wid: int) -> None:
+                k = 0
+                while not stop_flag.is_set():
+                    k += 1
+                    name = f"w{wid}_{k}.txt"
+                    # bucket tokens keep parity-query match sets well
+                    # under top_k: per-worker top-k truncation is only
+                    # set-stable when the k-boundary is not tied, so
+                    # the oracle comparison must never cut a tie
+                    text = (f"shared uniq{wid}x{k} cycle{k % 4} "
+                            f"bucket{k % 29}")
+                    try:
+                        if not writes_ok.is_set():
+                            _search(leader_url(), "shared")
+                            time.sleep(0.05)
+                            continue
+                        if k % 7 == 6:
+                            # idempotent upsert: re-upload one of THIS
+                            # thread's acked docs with its own text
+                            # (same oracle state; per-doc op order is
+                            # sequential because every doc belongs to
+                            # exactly one thread — a cross-thread
+                            # delete/re-upload race would make the
+                            # linearized outcome unknowable)
+                            with lock:
+                                done = [(n, t) for n, t in acked.items()
+                                        if n not in deleted
+                                        and n.startswith(f"w{wid}_")]
+                            if done:
+                                n0, t0 = done[k % len(done)]
+                                json.loads(http_post(
+                                    leader_url() + "/leader/upload-batch",
+                                    json.dumps([{"name": n0,
+                                                 "text": t0}]).encode(),
+                                    timeout=10.0))
+                        elif k % 5 == 4:
+                            with lock:
+                                cands = [n for n in acked
+                                         if n not in deleted
+                                         and n.startswith(f"w{wid}_")]
+                            if cands:
+                                victim = cands[wid % len(cands)]
+                                with lock:
+                                    ambiguous.add(victim)
+                                json.loads(http_post(
+                                    leader_url() + "/leader/delete",
+                                    json.dumps(
+                                        {"names": [victim]}).encode(),
+                                    timeout=10.0))
+                                with lock:
+                                    deleted.add(victim)
+                                    ambiguous.discard(victim)
+                        else:
+                            with lock:
+                                ambiguous.add(name)
+                            r = json.loads(http_post(
+                                leader_url() + "/leader/upload-batch",
+                                json.dumps([{"name": name,
+                                             "text": text}]).encode(),
+                                timeout=10.0))
+                            with lock:
+                                if name not in r.get("failed", ()):
+                                    acked[name] = text
+                                ambiguous.discard(name)
+                        _search(leader_url(), "shared")
+                    except Exception:
+                        pass       # failed op: stays ambiguous
+                    time.sleep(0.05)
+
+            threads = [threading.Thread(target=workload, args=(i,),
+                                        daemon=True) for i in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(1.0)
+
+            # ---- schedule 1: depose the node leader (control cut) ----
+            writes_ok.clear()          # quiesce workload writes (the
+            time.sleep(0.3)            # in-flight ones drain)
+            old = next(n for n in nodes if n._role == "leader")
+            coord_eps = list(peers.values())
+            global_nemesis.partition([old.url], coord_eps)
+            new = None
+
+            def promoted():
+                nonlocal new
+                for n in nodes:
+                    if n is not old and n._role == "leader":
+                        new = n
+                        return True
+                return False
+
+            assert wait_until(promoted, timeout=30)
+            # fence the workers forward, then drive a write through the
+            # DEPOSED leader: it must be rejected, never acked
+            _upload_docs(new.url, {"fencer.txt": "shared fencer"})
+            with lock:
+                acked["fencer.txt"] = "shared fencer"
+            with pytest.raises(Exception):
+                http_post(old.url + "/leader/upload?name=brain.txt",
+                          b"splitbrain token", timeout=30.0)
+            assert global_metrics.get("fence_rejections") >= 1
+            global_nemesis.heal()
+            assert wait_until(lambda: len(
+                new.registry.get_all_service_addresses()) == 2,
+                timeout=40)
+            writes_ok.set()            # schedule 1 over: writes resume
+
+            # ---- schedule 2: split the coordinator ensemble ----
+            coord_leader = next(
+                (nid for nid, s in servers.items()
+                 if s.ensemble.is_leader()), None)
+            if coord_leader is not None:
+                others = [a for nid, a in peers.items()
+                          if nid != coord_leader]
+                global_nemesis.partition([peers[coord_leader]], others)
+                time.sleep(3.0)     # a new coord leader forms; clients
+                global_nemesis.heal()   # fail over through the string
+                time.sleep(1.0)
+
+            # ---- schedule 3: one-way isolate a worker ----
+            cur = next(n for n in nodes if n._role == "leader")
+            ws = cur.registry.get_all_service_addresses()
+            if ws:
+                global_nemesis.one_way(cur.url, ws[0])
+                time.sleep(2.0)
+                global_nemesis.heal()
+
+            # ---- schedule 4: flap the full mesh ----
+            everything = [n.url for n in nodes] + coord_eps
+            for _ in range(3):
+                global_nemesis.isolate(everything)
+                time.sleep(0.3)
+                global_nemesis.heal()
+                time.sleep(0.3)
+
+            stop_flag.set()
+            for t in threads:
+                t.join(timeout=15)
+
+            # ---- converge, then verify ----
+            def settled_leader():
+                live = [n for n in nodes if n._role == "leader"]
+                return live[0] if len(live) == 1 else None
+
+            assert wait_until(
+                lambda: settled_leader() is not None, timeout=60)
+            fin = settled_leader()
+            assert wait_until(lambda: len(
+                fin.registry.get_all_service_addresses()) == 2,
+                timeout=60)
+
+            with lock:
+                must_have = {n: t for n, t in acked.items()
+                             if n not in deleted and n not in ambiguous}
+                must_not = {n for n in deleted if n not in ambiguous}
+                amb = set(ambiguous)
+
+            # per-doc presence via each doc's unique token
+            def uniq_token(name):
+                if not name.startswith("w"):
+                    return "fencer"          # the schedule-1 probe doc
+                wid, k = name[1:-4].split("_")
+                return f"uniq{wid}x{k}"
+
+            def converged():
+                try:
+                    url = settled_leader().url
+                    for n in must_have:
+                        if n not in _search(url, uniq_token(n)):
+                            return False
+                    for n in must_not:
+                        if n in _search(url, uniq_token(n)):
+                            return False
+                    return True
+                except Exception:
+                    return False
+
+            def forensics():
+                out = {"missing": [n for n in must_have
+                                   if n not in _search(
+                                       fin.url, uniq_token(n))][:10],
+                       "resurrected": {}}
+                for n in must_not:
+                    if n not in _search(fin.url, uniq_token(n)):
+                        continue
+                    holders = []
+                    for nd in nodes:
+                        try:
+                            hits = json.loads(http_post(
+                                nd.url + "/worker/process",
+                                uniq_token(n).encode()))
+                            if any(h["document"]["name"] == n
+                                   for h in hits):
+                                holders.append(nd.url)
+                        except Exception:
+                            pass
+                    out["resurrected"][n] = {
+                        "engines": holders,
+                        "map": fin.placement.holders_of(n),
+                        "pending": {w: (n in ns) for w, ns in
+                                    fin.placement.pending_moved()
+                                    .items()}}
+                return out
+
+            assert wait_until(converged, timeout=120,
+                              interval=0.5), forensics()
+            # zero acked-write loss pinned above; zero stale writes:
+            assert _search(fin.url, "splitbrain") == {}
+
+            # exact oracle parity over the discovered final doc set
+            final_docs = dict(must_have)
+            for name in amb:
+                if name in deleted:
+                    continue
+                hit = _search(fin.url, uniq_token(name))
+                if name in hit:
+                    wid, k = name[1:-4].split("_")
+                    final_docs[name] = (
+                        f"shared uniq{wid}x{k} cycle{int(k) % 4} "
+                        f"bucket{int(k) % 29}")
+            final_docs["fencer.txt"] = "shared fencer"
+            queries = ["bucket1", "bucket7", "bucket3 bucket11",
+                       "fencer"]
+            want = _oracle(tmp_path, final_docs, queries)
+
+            def parity():
+                try:
+                    url = settled_leader().url
+                    return all(_parity(_search(url, q), want[q])
+                               for q in queries)
+                except Exception:
+                    return False
+
+            def diffs():
+                out = {}
+                for q in queries:
+                    got = _search(fin.url, q)
+                    w = want[q]
+                    if _parity(got, w):
+                        continue
+                    out[q] = {
+                        "sizes": (len(got), len(w)),
+                        "extra": sorted(set(got) - set(w))[:6],
+                        "missing": sorted(set(w) - set(got))[:6],
+                        "score_mismatch": [
+                            (k, got[k], w[k]) for k in got
+                            if k in w and abs(got[k] - w[k]) >= 1e-4][:6]}
+                return out
+
+            assert wait_until(parity, timeout=120, interval=0.5), diffs()
+        finally:
+            stop_flag.set()
+            _stop_all(nodes)
+            for s in servers.values():
+                try:
+                    s.close()
+                except Exception:
+                    pass

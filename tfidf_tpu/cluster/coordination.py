@@ -39,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, NamedTuple
 from urllib.parse import parse_qs, urlparse
 
+from tfidf_tpu.cluster.nemesis import global_nemesis
 from tfidf_tpu.cluster.resilience import RetryPolicy
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
@@ -821,7 +822,8 @@ class CoordinationClient(_BaseCoordination):
     def __init__(self, address: str,
                  heartbeat_interval_s: float | None = None,
                  timeout_s: float = 5.0,
-                 failover_deadline_s: float = 10.0) -> None:
+                 failover_deadline_s: float = 10.0,
+                 origin: str = "") -> None:
         super().__init__()
         self.addresses = [a.strip() for a in address.split(",") if a.strip()]
         assert self.addresses, "at least one coordinator address required"
@@ -829,6 +831,19 @@ class CoordinationClient(_BaseCoordination):
         # how long one logical op keeps rotating/redirecting before
         # giving up — must comfortably span an ensemble leader election
         self.failover_deadline_s = failover_deadline_s
+        # this client's endpoint identity for the nemesis shim
+        # (cluster/nemesis.py); SearchNode stamps its own URL here
+        self.origin = origin
+        # jittered, per-instance reconnect backoff for the rotate/
+        # retry sleeps in _rpc and _poll: after a healed partition
+        # every client would otherwise re-attempt on the same fixed
+        # 20 Hz beat — a synchronized thundering herd on the freshly
+        # recovered coordinator. Per-instance rng -> decorrelated
+        # phases; exponential growth caps the per-client retry rate
+        # while the outage lasts. (The heartbeat loop's RetryPolicy
+        # below is jittered the same way by default.)
+        self._reconnect = RetryPolicy(base_delay_s=0.05, max_delay_s=0.8,
+                                      name="coord_reconnect")
         self._addr_lock = threading.Lock()
         self._addr_i = 0
         self._last_good: str | None = None
@@ -891,6 +906,14 @@ class CoordinationClient(_BaseCoordination):
     _MUTATING_OPS = frozenset(
         {"create", "delete", "set_data", "close_session"})
 
+    def _reconnect_sleep(self, attempt: int) -> None:
+        """One jittered backoff sleep before re-rotating (see
+        ``_reconnect`` in ``__init__``). Routed through the policy's
+        injectable ``_sleep`` so tests can record the chosen delays."""
+        global_metrics.inc("coord_reconnect_backoffs")
+        self._reconnect._sleep(
+            self._reconnect.backoff_delay(min(max(attempt, 1), 5)))
+
     @staticmethod
     def _definitely_undelivered(e: Exception) -> bool:
         if isinstance(e, ConnectionRefusedError):
@@ -913,9 +936,11 @@ class CoordinationClient(_BaseCoordination):
                                        headers={"Content-Type":
                                                 "application/json"})
             try:
+                global_nemesis.check_send(self.origin, base)
                 with urllib.request.urlopen(
                         r, timeout=self.timeout_s) as resp:
-                    payload = json.loads(resp.read())
+                    payload = json.loads(global_nemesis.filter_reply(
+                        self.origin, base, resp.read()))
                 self._note_success(base, _rearm)
                 return payload
             except urllib.error.HTTPError as e:
@@ -931,8 +956,13 @@ class CoordinationClient(_BaseCoordination):
                     # rejected before execution: always safe to retry
                     last_exc = e
                     self._redirect(payload.get("leader"))
-                    # no hint = mid-election: wait for it to conclude
-                    time.sleep(0.02 if payload.get("leader") else 0.1)
+                    if payload.get("leader"):
+                        time.sleep(0.02)
+                    else:
+                        # no hint = mid-election: jittered wait so a
+                        # whole cluster of clients doesn't re-poll the
+                        # forming ensemble in lock-step
+                        self._reconnect_sleep(tries)
                     continue
                 if err == "unavailable" or e.code >= 500:
                     if err == "unavailable" and mutating:
@@ -942,7 +972,7 @@ class CoordinationClient(_BaseCoordination):
                             payload.get("detail", "no quorum"))
                     last_exc = e
                     self._advance()
-                    time.sleep(0.05)
+                    self._reconnect_sleep(tries)
                     continue
                 raise
             except (urllib.error.URLError, ConnectionError, OSError,
@@ -952,7 +982,7 @@ class CoordinationClient(_BaseCoordination):
                     raise
                 last_exc = e
                 self._advance()
-                time.sleep(0.05)
+                self._reconnect_sleep(tries)
                 continue
         raise last_exc
 
@@ -1041,9 +1071,11 @@ class CoordinationClient(_BaseCoordination):
             url = (f"http://{base}/events?session={self.sid}"
                    f"&timeout={timeout_s}")
             try:
+                global_nemesis.check_send(self.origin, base)
                 with urllib.request.urlopen(
                         url, timeout=timeout_s + 5) as resp:
-                    payload = json.loads(resp.read())
+                    payload = json.loads(global_nemesis.filter_reply(
+                        self.origin, base, resp.read()))
                 self._note_success(base)
                 break
             except urllib.error.HTTPError as e:
@@ -1051,17 +1083,20 @@ class CoordinationClient(_BaseCoordination):
                 if body.get("error") == "not_leader":
                     last_exc = e
                     self._redirect(body.get("leader"))
-                    time.sleep(0.02 if body.get("leader") else 0.1)
+                    if body.get("leader"):
+                        time.sleep(0.02)
+                    else:
+                        self._reconnect_sleep(tries)
                     continue
                 last_exc = e
                 self._advance()
-                time.sleep(0.05)
+                self._reconnect_sleep(tries)
             except (urllib.error.URLError, ConnectionError, OSError,
                     TimeoutError) as e:
                 self._conn_failed = True
                 last_exc = e
                 self._advance()
-                time.sleep(0.05)
+                self._reconnect_sleep(tries)
         if payload is None:
             raise last_exc
         evs = [Event(t, p) for t, p in payload["events"]]

@@ -58,6 +58,20 @@ class LeaderElection:
         assert self.znode is not None, "volunteer_for_leadership first"
         return self.znode.rsplit("/", 1)[1]
 
+    def epoch(self) -> int | None:
+        """Monotonic leadership epoch: this candidate's own sequence
+        number, parsed from the ephemeral-sequential znode name. The
+        leader is the SMALLEST live candidate and the parent's counter
+        only grows, so every successive leader's epoch strictly
+        increases across failovers, resignations, and rejoins — the
+        fencing token the mutating data plane stamps as
+        ``X-Leader-Epoch`` (cluster/fencing.py). None before
+        volunteering (or after resigning)."""
+        if self.znode is None:
+            return None
+        suffix = self._my_name[len(CANDIDATE_PREFIX):]
+        return int(suffix) if suffix.isdigit() else None
+
     # ``reelectLeader`` (:57-86): loop until we are leader or hold a watch
     # on a live predecessor (the predecessor may vanish between the listing
     # and the watch registration — same retry loop as the reference).

@@ -50,6 +50,7 @@ import urllib.request
 from tfidf_tpu.cluster.coordination import (CoordinationCore,
                                             CoordinationUnavailable,
                                             NotLeaderError)
+from tfidf_tpu.cluster.nemesis import global_nemesis
 from tfidf_tpu.cluster.wal import DurableStore
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
@@ -63,13 +64,17 @@ _MAX_BATCH = 128          # entries per AppendEntries RPC
 
 
 def _post_json(address: str, path: str, obj: dict,
-               timeout_s: float) -> dict:
+               timeout_s: float, origin: str = "") -> dict:
+    # peer-replication seam for the network nemesis (cluster/nemesis.py):
+    # ensemble splits are scripted per (member, member) link
+    global_nemesis.check_send(origin, address)
     body = json.dumps(obj).encode()
     req = urllib.request.Request(
         f"http://{address}{path}", data=body,
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-        return json.loads(resp.read())
+        return json.loads(global_nemesis.filter_reply(
+            origin, address, resp.read()))
 
 
 class _Waiter:
@@ -453,7 +458,8 @@ class EnsembleNode:
                       votes: dict) -> None:
         try:
             resp = _post_json(addr, "/ensemble/vote", req,
-                              self.rpc_timeout_s)
+                              self.rpc_timeout_s,
+                              origin=self.my_address)
         except Exception:
             return
         with self._lock:
@@ -532,7 +538,8 @@ class EnsembleNode:
             global_injector.check(f"ensemble.replicate_append.{pid}")
             if req["kind"] == "snapshot":
                 resp = _post_json(addr, "/ensemble/snapshot", req,
-                                  self.rpc_timeout_s)
+                                  self.rpc_timeout_s,
+                                  origin=self.my_address)
                 with self._lock:
                     if resp.get("term", 0) > self.term:
                         self._observe_term_locked(resp["term"])
@@ -542,7 +549,8 @@ class EnsembleNode:
                         self._match_index.get(pid, 0), req["last_index"])
                 continue
             resp = _post_json(addr, "/ensemble/append", req,
-                              self.rpc_timeout_s)
+                              self.rpc_timeout_s,
+                              origin=self.my_address)
             with self._lock:
                 if resp.get("term", 0) > self.term:
                     self._observe_term_locked(resp["term"])

@@ -50,16 +50,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tfidf_tpu.cluster.admission import (LANE_BULK, LANE_INTERACTIVE,
                                          AdmissionController, ResultCache)
 from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
+from tfidf_tpu.cluster.coordination import NoNodeError
 from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
                                     unpack_hit_lists)
 from tfidf_tpu.cluster.election import LeaderElection
+from tfidf_tpu.cluster.fencing import (FENCE_EPOCH_HEADER, FENCE_HEADER,
+                                       FENCE_REJECTED_HEADER,
+                                       FENCE_STATUS, FenceGuard)
+from tfidf_tpu.cluster.nemesis import global_nemesis
 from tfidf_tpu.cluster.placement import PlacementMap
 from tfidf_tpu.cluster.rebalance import Rebalancer
 from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
 from tfidf_tpu.cluster.resilience import (CircuitOpenError,
                                           ClusterResilience,
                                           DeadlineExpired, RpcStatusError,
-                                          hedge_laggards)
+                                          hedge_laggards,
+                                          is_fence_rejection)
 from tfidf_tpu.engine.engine import Engine
 from tfidf_tpu.ops.analyzer import UnsupportedMediaType
 from tfidf_tpu.utils.config import Config
@@ -71,10 +77,17 @@ log = get_logger("cluster.node")
 
 
 # ---- tiny HTTP client helpers (RestTemplate analog, Leader.java:42) ----
+#
+# Both helpers (and _ScatterClient.post below) pass through the nemesis
+# shim (cluster/nemesis.py): an ``origin`` identifies the calling node
+# so tests can script per-link partitions/latency/corruption without
+# monkeypatching any call site. No rules armed = one emptiness check.
 
-def http_get(url: str, timeout: float = 10.0) -> bytes:
+def http_get(url: str, timeout: float = 10.0,
+             origin: str | None = None) -> bytes:
+    global_nemesis.check_send(origin, url)
     with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.read()
+        return global_nemesis.filter_reply(origin, url, r.read())
 
 
 class _ScatterClient:
@@ -105,11 +118,15 @@ class _ScatterClient:
 
     def __init__(self) -> None:
         self._tls = threading.local()
+        # this node's endpoint identity for the nemesis shim (stamped
+        # by SearchNode.start once the server port is known)
+        self.origin = ""
 
     def post(self, base: str, path: str, data: bytes,
              timeout: float = 10.0, live: set[str] | None = None,
              headers: dict[str, str] | None = None) -> bytes:
         import http.client
+        global_nemesis.check_send(self.origin, base)
         u = urllib.parse.urlparse(base)
         conns = getattr(self._tls, "conns", None)
         if conns is None:
@@ -151,7 +168,8 @@ class _ScatterClient:
                 h.update(headers or {})
                 c.request("POST", path, body=data, headers=h)
                 r = c.getresponse()
-                body = r.read()
+                body = global_nemesis.filter_reply(self.origin, base,
+                                                   r.read())
                 if r.status >= 300:
                     # typed status error: the resilience layer retries
                     # gateway-transient statuses (502/503/504) and —
@@ -168,7 +186,9 @@ class _ScatterClient:
                         f"{base}{path}", r.status,
                         deadline_exceeded=(
                             r.getheader("X-Deadline-Exceeded") == "1"),
-                        retry_after_s=ra_s)
+                        retry_after_s=ra_s,
+                        fenced=(r.getheader(FENCE_REJECTED_HEADER)
+                                == "1"))
                 return body
             except RuntimeError:
                 raise
@@ -184,12 +204,14 @@ class _ScatterClient:
 
 
 def http_post(url: str, data: bytes, content_type: str = "application/json",
-              timeout: float = 30.0, headers: dict | None = None) -> bytes:
+              timeout: float = 30.0, headers: dict | None = None,
+              origin: str | None = None) -> bytes:
+    global_nemesis.check_send(origin, url)
     h = {"Content-Type": content_type}
     h.update(headers or {})
     req = urllib.request.Request(url, data=data, headers=h)
     with urllib.request.urlopen(req, timeout=timeout) as r:
-        return r.read()
+        return global_nemesis.filter_reply(origin, url, r.read())
 
 
 class WorkerDeadline(RuntimeError):
@@ -361,6 +383,9 @@ class SearchNode:
         # Reconciles run one at a time (_reconcile_serial) so a rejoin
         # cannot interleave with an in-flight recovery.
         self._reconcile_serial = threading.Lock()
+        # residue anti-entropy pacing (first pass one period in, like
+        # the rebalancer: let the post-election repair settle first)
+        self._residue_last = time.monotonic()
         # elastic data plane: live shard migration / drain, riding the
         # sweep loop below (cluster/rebalance.py)
         self.rebalancer = Rebalancer(self)
@@ -371,6 +396,17 @@ class SearchNode:
         # retry policy + per-worker circuit breakers shared by every
         # leader->worker RPC path (cluster/resilience.py)
         self.resilience = ClusterResilience(self.config)
+        # leadership fencing (cluster/fencing.py): the worker-side
+        # guard (highest leader epoch ever seen, durable beside the
+        # index so a reboot mid-partition cannot be captured by a
+        # deposed leader) and the leader-side epoch stamped on every
+        # mutating worker RPC. A fence rejection triggers an immediate
+        # step-down (_fence_step_down) — never a retry.
+        self.fence = FenceGuard(os.path.join(self.config.index_path,
+                                             "fence_epoch.json"))
+        self._leader_epoch: int | None = None
+        self._fence_lock = threading.Lock()
+        self._fence_stepping = False
         # workers that have EVER contributed unmapped (legacy
         # sum-merge) hits: if one of them later fails, the map cannot
         # vouch for its unmapped documents — the degraded marker stays
@@ -425,9 +461,19 @@ class SearchNode:
 
     # ---- lifecycle (app/Application.java:33-46) ----
 
+    def _stamp_net_origin(self, coord) -> None:
+        """Identify this node's outbound traffic to the nemesis shim:
+        the scatter client and (when the coordination client supports
+        it and a test has not already named it) the control-plane
+        client share the node's own endpoint identity."""
+        self._scatter.origin = self.url
+        if getattr(coord, "origin", None) == "":
+            coord.origin = self.url
+
     def start(self, rebuild: bool = True,
               rebuild_newer_than: float | None = None) -> "SearchNode":
         self._server_thread.start()
+        self._stamp_net_origin(self.coord)
         if rebuild:   # boot-time re-walk (Worker.java:77-88); after a
             # checkpoint restore only documents written since the save
             # are re-analyzed (idempotent upserts)
@@ -709,6 +755,7 @@ class SearchNode:
             try:
                 coord = self._coord_factory()
                 self.coord = coord
+                self._stamp_net_origin(coord)
                 self.registry = ServiceRegistry(
                     coord, on_change=self._on_membership_change)
                 self.election = LeaderElection(coord, callback=self)
@@ -752,12 +799,23 @@ class SearchNode:
 
     def on_elected_to_be_leader(self) -> None:
         self._role = "leader"   # cached for the non-blocking /api/health
+        # leadership epoch, issued at promotion: the election znode's
+        # own sequence number (strictly grows across successions —
+        # cluster/fencing.py). Stamped on every mutating worker RPC and
+        # into the durable placement znode; this node's own worker
+        # plane advances its fence NOW so a deposed predecessor cannot
+        # write here even before the first fenced RPC arrives.
+        epoch = self.election.epoch()
+        self._leader_epoch = epoch
+        self.placement.epoch = epoch
+        if epoch is not None:
+            self.fence.observe(epoch)
         # the leader does not serve a shard: leave the worker pool (:30)
         self.registry.unregister_from_cluster()
         self.registry.register_for_updates()
         publish_leader_info(self.coord, self.url)
         global_metrics.inc("elections_won")
-        log.info("assumed leader role", url=self.url)
+        log.info("assumed leader role", url=self.url, epoch=epoch)
         # resume ownership: load the durable placement map (and its
         # pending-reconcile state) off-thread — this callback can run
         # on the watch-dispatch thread, and the load is a coordination
@@ -778,6 +836,16 @@ class SearchNode:
         predecessor's durable one would permanently strip failover
         coverage from every document placed before this tenure — a
         stale durable map is strictly better than a clobbered one."""
+        # fence round FIRST: push the new epoch to every live worker
+        # NOW, so a deposed predecessor (possibly still alive behind a
+        # partition) cannot land even one more write in the promotion
+        # gap — without this, the split-brain window stays open until
+        # this leader's first organic mutating RPC happens to reach
+        # each worker
+        try:
+            self._fence_workers()
+        except Exception as e:
+            log.warning("promotion fence round failed", err=repr(e))
         loaded = self.config.placement_flush_ms < 0   # nothing to load
         if not loaded:
             delay = 0.2
@@ -824,6 +892,11 @@ class SearchNode:
 
     def on_worker(self) -> None:
         self._role = "worker"   # cached for the non-blocking /api/health
+        # a demoted node holds no leadership epoch: mutating RPCs it
+        # somehow still issues would go unstamped (and its placement
+        # flushes are disabled below anyway)
+        self._leader_epoch = None
+        self.placement.epoch = None
         # a worker must never write the leader's placement state, and
         # a DEMOTED ex-leader must not carry its tenure's map into a
         # possible later re-promotion — the durable znode (written by
@@ -836,6 +909,121 @@ class SearchNode:
 
     def is_leader(self) -> bool:
         return self.election.is_leader()
+
+    # ---- leadership fencing (cluster/fencing.py) ----
+
+    def _fence_workers(self) -> None:
+        """Promotion fence round: an empty, epoch-stamped
+        ``/worker/delete`` to every live worker advances each worker's
+        durable fence to this tenure's epoch — after it lands, no RPC
+        from any predecessor can be accepted anywhere. Best-effort per
+        worker (an unreachable worker is fenced by this leader's first
+        real write to it, or rejects the predecessor anyway once any
+        stamped RPC arrives); counted in ``fence_rounds``."""
+        if self._leader_epoch is None:
+            return
+        workers = self.registry.get_all_service_addresses()
+        if not workers:
+            return
+        body = json.dumps({"names": []}).encode()
+        fenced = 0
+        for w in workers:
+            try:
+                self._worker_call_fenced(
+                    w, lambda w=w: http_post(
+                        w + "/worker/delete", body, timeout=10.0,
+                        headers=self._epoch_headers(), origin=self.url))
+                fenced += 1
+            except Exception as e:
+                log.warning("promotion fence push failed", worker=w,
+                            err=repr(e))
+        if fenced:
+            global_metrics.inc("fence_rounds")
+            log.info("promotion fence round complete", workers=fenced,
+                     epoch=self._leader_epoch)
+
+    def _epoch_headers(self) -> dict[str, str]:
+        """The fencing token for one mutating worker RPC. Empty when
+        this node holds no epoch (not leader / pre-election) — workers
+        never fence unstamped requests, so reference clients and
+        single-node deployments are untouched."""
+        epoch = self._leader_epoch
+        return {FENCE_HEADER: str(epoch)} if epoch is not None else {}
+
+    def _worker_call_fenced(self, worker: str, fn):
+        """``ClusterResilience.worker_call`` for MUTATING RPCs: a
+        leadership-fence rejection (403 + X-Fence-Rejected) triggers an
+        immediate step-down — a newer leader exists, so this node's
+        epoch can never become valid again; retrying would be the
+        split-brain the fence exists to stop. The rejection still
+        propagates to the caller as a failed leg (never acked)."""
+        try:
+            return self.resilience.worker_call(worker, fn)
+        except Exception as e:
+            if is_fence_rejection(e):
+                self._note_fence_rejection(worker, e)
+            raise
+
+    def _note_fence_rejection(self, worker: str, e: BaseException) -> None:
+        with self._fence_lock:
+            if self._fence_stepping:
+                return          # a step-down is already in flight
+            self._fence_stepping = True
+        log.warning("fenced by a newer leader epoch; stepping down",
+                    worker=worker, err=repr(e),
+                    my_epoch=self._leader_epoch)
+        global_metrics.inc("fence_step_downs")
+        threading.Thread(target=self._fence_step_down, daemon=True,
+                         name=f"fence-stepdown-{self.port}").start()
+
+    def _fence_step_down(self) -> None:
+        """Deposed-leader demotion: drop all leader authority NOW (in
+        memory — no further placement flushes, no stale map carried
+        into a later tenure), then resign the election znode and
+        re-enter as a fresh candidate (whose new sequence number mints
+        a HIGHER epoch, so a re-promotion is safe by construction).
+        Coordination may be unreachable — the very partition that got
+        us deposed — so re-entry retries with backoff and defers to the
+        session-expiry rejoin path the moment it takes over."""
+        election = self.election
+        try:
+            self._leader_epoch = None
+            self.placement.epoch = None
+            self.placement.set_persist_enabled(False)
+            self.placement.reset_for_follower()
+            self._role = "worker"
+            try:
+                election.resign()
+            except Exception as e:
+                # partitioned from the coordinator: the znode is (or
+                # will be) gone with the session anyway
+                log.warning("resign after fence failed", err=repr(e))
+            delay = 0.1
+            while not self._stopping:
+                if self.election is not election:
+                    return   # a session-expiry rejoin took over
+                try:
+                    self.election.volunteer_for_leadership()
+                    self.election.reelect_leader()
+                    log.info("re-entered election after fence "
+                             "step-down", url=self.url,
+                             leader=self.election.is_leader())
+                    return
+                except NoNodeError:
+                    # our session died during the partition: the
+                    # SESSION_EXPIRED event owns recovery (rejoin with
+                    # a fresh session)
+                    log.info("fence step-down defers to session-expiry "
+                             "rejoin")
+                    return
+                except Exception as e:
+                    log.warning("election re-entry after fence failed; "
+                                "retrying", err=repr(e))
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+        finally:
+            with self._fence_lock:
+                self._fence_stepping = False
 
     # ---- leader logic (leader/Leader.java) ----
 
@@ -1037,7 +1225,8 @@ class SearchNode:
                 timeout=remaining, live=live,
                 headers={"X-Deadline-Ms": str(int(remaining * 1e3))})
             return unpack_hit_lists(raw)
-        return self.resilience.worker_call(addr, rpc)
+        return self.resilience.worker_call(addr, rpc,
+                                           track_latency=True)
 
     def _gather_merge(self, queries: list[str], rpc_one,
                       t_deadline: float
@@ -1065,15 +1254,19 @@ class SearchNode:
         """
         workers = self.registry.get_all_service_addresses()
         live = set(workers)
-        self.resilience.board.prune(live)
+        self.resilience.prune(live)   # breakers + latency EWMAs
         excluded = self._pending_reconcile()
         open_set = frozenset(w for w in workers
                              if self.resilience.board.is_open(w))
         view = self.placement.owner_assignment(frozenset(live), open_set)
 
         def call(addr: str):
+            # scatter RPCs feed the gray-failure latency EWMA (slow
+            # worker detection is scoped to THIS path — bulk uploads
+            # legitimately take minutes and must not condemn a worker)
             return self.resilience.worker_call(
-                addr, lambda: rpc_one(addr, live, t_deadline))
+                addr, lambda: rpc_one(addr, live, t_deadline),
+                track_latency=True)
 
         futures = {self._pool.submit(call, w): w for w in workers}
 
@@ -1427,10 +1620,11 @@ class SearchNode:
             return json.loads(http_post(
                 w + "/worker/delete",
                 json.dumps({"names": sorted(moved)}).encode(),
-                timeout=120.0))
+                timeout=120.0, headers=self._epoch_headers(),
+                origin=self.url))
 
         try:
-            resp = self.resilience.worker_call(w, rpc)
+            resp = self._worker_call_fenced(w, rpc)
         except Exception as e:
             global_metrics.inc("reconcile_failures")
             log.warning("rejoin reconciliation failed", worker=w,
@@ -1470,6 +1664,14 @@ class SearchNode:
                 # elastic rebalance rides the same leader-side loop,
                 # self-paced by rebalance_sweep_ms
                 self.rebalancer.maybe_run()
+                # residue anti-entropy (ghost/orphan reconciliation),
+                # self-paced by residue_sweep_ms
+                now = time.monotonic()
+                if (self.config.residue_sweep_ms >= 0
+                        and now - self._residue_last
+                        >= self.config.residue_sweep_ms / 1e3):
+                    self._residue_last = now
+                    self.run_residue_reconcile()
             except Exception as e:
                 log.warning("reconcile sweep pass failed", err=repr(e))
 
@@ -1672,6 +1874,90 @@ class SearchNode:
         return {"replicated": added, "trimmed": n_trim,
                 "missing": repaired_missing}
 
+    def run_residue_reconcile(self) -> dict:
+        """Anti-entropy for UNMAPPED engine residue — the partition
+        leftovers owner assignment can only mask, never clean. Each
+        live worker reports the names its engine ACTUALLY serves
+        (``GET /worker/names``); copies the placement map does not
+        credit are either GHOSTS (mapped elsewhere / pending deletion:
+        scheduled away through the moved machinery — they silently
+        skew that shard's df/N statistics and resurface the moment the
+        name leaves the map) or ORPHANS (mapped nowhere: a write that
+        landed but whose placement was lost to a partition — adopted
+        as a first-class confirmed replica, then R-restored by the
+        repair pass). Public so tests and operators can force a pass;
+        self-paced in the sweep loop by ``residue_sweep_ms``."""
+        if self._stopping or not self.config.shard_recovery:
+            return {}
+        live = set(self.registry.get_all_service_addresses())
+        if not live:
+            return {}
+        protected = self.placement.migrating_names()
+        ghosts = orphans = 0
+        with self._reconcile_serial:
+            for w in sorted(live):
+                if self.resilience.board.is_open(w) or self._stopping:
+                    continue
+                try:
+                    payload = json.loads(self.resilience.worker_call(
+                        w, lambda w=w: http_get(
+                            w + "/worker/names", origin=self.url),
+                        retry=False))
+                except Exception as e:
+                    log.warning("residue name fetch failed", worker=w,
+                                err=repr(e))
+                    continue
+                names = payload.get("names")
+                if not names:
+                    continue   # empty engine, or a layout that can't list
+                g, o = self.placement.reconcile_residue(
+                    w, [str(n) for n in names], protected)
+                ghosts += len(g)
+                orphans += len(o)
+                if g or o:
+                    log.info("residue reconciled", worker=w,
+                             ghosts=len(g), orphans_adopted=len(o))
+            # the leader's OWN engine (an ex-worker's shard) can hold
+            # the ONLY copy of an orphan — it serves no scatter, so an
+            # unmapped doc here is unreachable until re-placed through
+            # the normal upload path
+            own = self.engine.document_names() or ()
+            replaced = 0
+            for name in self.placement.unplaced_of(
+                    [str(n) for n in own], protected):
+                if self._stopping:
+                    break
+                got = None
+                try:
+                    got = self.engine.open_document_stream(name)
+                except Exception:
+                    got = None
+                if got is None:
+                    continue
+                stream, _sz = got
+                try:
+                    data = stream.read()
+                finally:
+                    stream.close()
+                try:
+                    self.leader_upload(name, data)
+                    replaced += 1
+                except Exception as e:
+                    log.warning("residue re-place from own engine "
+                                "failed", file=name, err=repr(e))
+            if replaced:
+                global_metrics.inc("residue_leader_replaced", replaced)
+                log.info("re-placed orphans from the leader's own "
+                         "engine", docs=replaced)
+        if ghosts:
+            global_metrics.inc("residue_ghosts", ghosts)
+        if orphans:
+            global_metrics.inc("residue_orphans_adopted", orphans)
+            # adopted orphans change which shard scores those names
+            self.bump_result_generation()
+        global_metrics.inc("residue_sweeps")
+        return {"ghosts": ghosts, "orphans": orphans}
+
     def _load_doc_bytes(self, name: str) -> bytes | None:
         """Byte source for replica/migration copies: the leader's
         durable store first, else the download probe (its own engine
@@ -1721,10 +2007,11 @@ class SearchNode:
         """Forward one upload-batch of NEW replica copies to ``target``
         and record the accepted ones in the placement map."""
         try:
-            resp = json.loads(self.resilience.worker_call(
+            resp = json.loads(self._worker_call_fenced(
                 target, lambda: http_post(
                     target + "/worker/upload-batch",
-                    json.dumps(docs).encode(), timeout=300.0)))
+                    json.dumps(docs).encode(), timeout=300.0,
+                    headers=self._epoch_headers(), origin=self.url)))
         except Exception as e:
             log.warning("replica repair batch failed", worker=target,
                         docs=len(docs), err=repr(e))
@@ -1732,24 +2019,32 @@ class SearchNode:
         skipped = {s["name"] for s in resp.get("skipped", ())}
         n = 0
         for d in docs:
-            if d["name"] not in skipped:
-                self.placement.add_replica(d["name"], target)
+            if d["name"] in skipped:
+                continue
+            if self.placement.add_replica(d["name"], target):
                 n += 1
+            else:
+                # a client delete won the race against this copy leg:
+                # the landed bytes are a stray — schedule them away
+                self.placement.note_stray(d["name"], target)
         return n
 
     def _add_replica_file(self, target: str, name: str,
                           data: bytes) -> int:
         q = urllib.parse.quote(name)
         try:
-            self.resilience.worker_call(
+            self._worker_call_fenced(
                 target, lambda: http_post(
                     target + f"/worker/upload?name={q}", data,
-                    content_type="application/octet-stream"))
+                    content_type="application/octet-stream",
+                    headers=self._epoch_headers(), origin=self.url))
         except Exception as e:
             log.warning("replica repair upload failed", worker=target,
                         file=name, err=repr(e))
             return 0
-        self.placement.add_replica(name, target)
+        if not self.placement.add_replica(name, target):
+            self.placement.note_stray(name, target)   # deleted mid-copy
+            return 0
         return 1
 
     # size polls are cached this long; between polls the leader grows
@@ -1781,7 +2076,8 @@ class SearchNode:
             try:
                 def poll(w=w) -> int:
                     global_injector.check("leader.size_poll")
-                    return int(http_get(w + "/worker/index-size"))
+                    return int(http_get(w + "/worker/index-size",
+                                        origin=self.url))
                 # breaker-tracked, no retry: the TTL cache re-polls soon
                 # anyway, and failed polls feed the breaker so repeat
                 # offenders drop out of the serial loop above
@@ -1892,11 +2188,14 @@ class SearchNode:
         def send(w: str):
             # retried (bounded) on transient transport failures: the
             # worker-side ingest is an idempotent upsert by name, so a
-            # double-applied attempt converges to the same index state
-            return self.resilience.worker_call(
+            # double-applied attempt converges to the same index state.
+            # Epoch-stamped and fence-aware: a 403 fence rejection
+            # means a newer leader exists — step down, never retry.
+            return self._worker_call_fenced(
                 w, lambda w=w: http_post(
                     w + f"/worker/upload?name={q}", data,
-                    content_type="application/octet-stream"))
+                    content_type="application/octet-stream",
+                    headers=self._epoch_headers(), origin=self.url))
 
         futs = {self._pool.submit(send, w): w for w in replicas}
         confirmed: list[str] = []
@@ -2012,11 +2311,13 @@ class SearchNode:
 
         def forward(w: str, group: list[dict]) -> dict:
             # bounded transient retry; worker-side ingest is an
-            # idempotent upsert by name (see leader_upload)
-            return json.loads(self.resilience.worker_call(
+            # idempotent upsert by name (see leader_upload).
+            # Epoch-stamped + fence-aware like every mutating RPC.
+            return json.loads(self._worker_call_fenced(
                 w, lambda: http_post(
                     w + "/worker/upload-batch",
-                    json.dumps(group).encode(), timeout=300.0)))
+                    json.dumps(group).encode(), timeout=300.0,
+                    headers=self._epoch_headers(), origin=self.url)))
 
         futs = {self._pool.submit(forward, w, group): (w, group)
                 for w, group in per_worker.items()}
@@ -2080,6 +2381,103 @@ class SearchNode:
                              if d["name"] not in confirmed_names
                              and d["name"] not in skipped_by_name]
         return out
+
+    def leader_delete(self, names: list[str]) -> dict:
+        """Cluster-wide document deletion (framework addition — the
+        reference cannot delete a placed document at all; the jepsen
+        partition workload needs a client-driven delete leg).
+
+        Ordering makes the ack honest under crashes and partitions:
+
+        1. the names leave the placement map and their copies enter
+           the pending-reconcile (``moved``) machinery — merged search
+           results exclude them IMMEDIATELY, before any worker RPC;
+        2. the removal is made durable (synchronous placement flush —
+           a flush failure fails the request, so an acked delete can
+           never resurrect on a new leader);
+        3. the leader's durable byte copy is dropped (repair can no
+           longer re-place it — it already cannot, the map entry is
+           gone, but the store must not outlive the doc);
+        4. the worker-side deletes are pushed now (fenced, epoch-
+           stamped); any failed leg is retried by the reconcile sweep
+           — the pending exclusion keeps results exact meanwhile."""
+        names = [str(n) for n in names]
+        live = set(self.registry.get_all_service_addresses())
+        # blanket-schedule across every LIVE worker, not just mapped
+        # holders: a ghost copy (an upload leg recorded failed whose
+        # request the worker actually processed) is masked by owner
+        # assignment only while the name is mapped — the delete must
+        # hunt it down everywhere or it resurrects unmapped
+        scheduled = self.placement.forget(names, also=live)
+        # invalidate cached results NOW — the map already excludes the
+        # names, so a cache hit serving them would disagree with every
+        # fresh scatter (and the fenced push loop below can stall for
+        # seconds against a partitioned worker)
+        self.bump_result_generation()
+        if scheduled and not self._delete_flush_ok():
+            raise RuntimeError(
+                "delete not acknowledged: placement removal could not "
+                "be made durable (the doc is gone from THIS leader's "
+                "results, but a failover could resurrect it)")
+        for n in names:
+            try:
+                path = self._store_path(n)
+                if os.path.isfile(path):
+                    os.remove(path)
+            except Exception as e:
+                log.warning("durable store cleanup failed", file=n,
+                            err=repr(e))
+            # purge the leader's OWN engine copy too (an ex-worker's
+            # shard, or the dual-role single-node case): the residue
+            # pass re-places own-engine orphans, so a lingering local
+            # copy of a deleted doc would resurrect through it
+            try:
+                if self.engine.remove_document(n):
+                    self.notify_write()
+            except Exception:
+                pass
+        deleted = 0
+        for w, ns in scheduled.items():
+            if w not in live:
+                continue   # sweep/rejoin reconcile owns it later
+
+            def rpc(w=w, ns=ns) -> dict:
+                global_injector.check("leader.reconcile_rpc")
+                return json.loads(http_post(
+                    w + "/worker/delete",
+                    json.dumps({"names": sorted(ns)}).encode(),
+                    timeout=120.0, headers=self._epoch_headers(),
+                    origin=self.url))
+
+            try:
+                resp = self._worker_call_fenced(w, rpc)
+            except Exception as e:
+                global_metrics.inc("reconcile_failures")
+                log.warning("delete push failed (sweep will retry)",
+                            worker=w, err=repr(e))
+                continue
+            self.placement.moved_resolved(w, set(ns))
+            deleted += int(resp.get("deleted", 0))
+        if deleted:
+            # the landed engine deletes shifted worker-side df: results
+            # cached since the first bump were computed pre-delete
+            self.bump_result_generation()
+        global_metrics.inc("docs_cluster_deleted", len(names))
+        return {"forgotten": len(names), "deleted": deleted}
+
+    def _delete_flush_ok(self) -> bool:
+        """Make a delete's placement removal durable. True when the
+        flush landed OR persistence is structurally off (per-tenure
+        map / no store bound — nothing to resurrect from); False only
+        when a real durable map exists and could not be updated."""
+        if (self.config.placement_flush_ms < 0
+                or not self.placement._persist_enabled):
+            return True
+        try:
+            return self.placement.flush()
+        except Exception as e:
+            log.warning("delete placement flush failed", err=repr(e))
+            return False
 
     def leader_download_stream(self, rel: str):
         """Locate a document and return a readable stream + size for
@@ -2208,6 +2606,37 @@ class _NodeHandler(BaseHTTPRequestHandler):
             return True
         return False
 
+    def _fence_check(self) -> bool:
+        """Leadership fence on the mutating worker endpoints
+        (``/worker/upload[-batch]``, ``/worker/delete``): a request
+        stamped with a LOWER epoch than the highest this worker ever
+        saw is answered with the distinct fence status (403 +
+        ``X-Fence-Rejected: 1``) — the sender is a deposed leader and
+        must step down, not retry. Unstamped requests (external /
+        reference clients, single-node mode) are never fenced. Returns
+        True when the rejection was sent. Callers read the body BEFORE
+        checking so a rejected keep-alive connection stays in sync."""
+        hdr = self.headers.get(FENCE_HEADER)
+        if hdr is None:
+            return False
+        try:
+            epoch = int(hdr)
+        except ValueError:
+            return False
+        node = self.node
+        global_injector.check("worker.fence")
+        if node.fence.observe(epoch):
+            return False
+        current = node.fence.current()
+        global_metrics.inc("fence_rejections")
+        log.warning("fenced a stale-leader write", stale_epoch=epoch,
+                    current_epoch=current, path=self.path)
+        self._send(FENCE_STATUS, b"stale leader epoch",
+                   "text/plain; charset=utf-8",
+                   headers={FENCE_REJECTED_HEADER: "1",
+                            FENCE_EPOCH_HEADER: str(current)})
+        return True
+
     # ---- admission plumbing (cluster/admission.py) ----
 
     def _client_lane(self, default_lane: str) -> tuple[str, str]:
@@ -2301,6 +2730,11 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     "admission": node.admission.snapshot()})
             elif u.path == "/worker/index-size":
                 self._text(str(node.engine.index_size_bytes()))
+            elif u.path == "/worker/names":
+                # ground truth for the leader's residue anti-entropy
+                # pass: what THIS engine actually serves (names: null
+                # when the index layout cannot list — mesh layouts)
+                self._json({"names": node.engine.document_names()})
             elif u.path == "/worker/download":
                 self._download_from_engine(u)
             elif u.path == "/leader/download":
@@ -2430,6 +2864,8 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 self._send(200, body, "application/octet-stream")
             elif u.path == "/worker/upload":
                 name, data = self._read_upload(u)
+                if self._fence_check():   # after the body read: the
+                    return                # rejected conn stays in sync
                 if not name:
                     self._text("missing file name", 400)
                     return
@@ -2454,6 +2890,8 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 self._text(f"File {name} uploaded and indexed")
             elif u.path == "/worker/upload-batch":
                 docs = json.loads(self._body().decode("utf-8"))
+                if self._fence_check():
+                    return
                 global_injector.check("worker.upload")
                 skipped = []
                 try:
@@ -2480,6 +2918,8 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 # them). Framework addition — the reference cannot move
                 # documents between workers at all.
                 names = json.loads(self._body().decode("utf-8"))
+                if self._fence_check():
+                    return
                 names = names.get("names", []) if isinstance(names, dict) \
                     else names
                 removed = sum(
@@ -2557,6 +2997,19 @@ class _NodeHandler(BaseHTTPRequestHandler):
                                         ("attempted", "responded",
                                          "circuit_open")})}
                 self._json(result, headers=hdrs)
+            elif u.path == "/leader/delete":
+                # placement-aware cluster-wide deletion (the upsert/
+                # delete/search partition workload's delete leg); bulk
+                # lane like every other mutating front-door endpoint
+                client, lane = self._client_lane(LANE_BULK)
+                decision = node.admission.admit(client, lane)
+                if not decision.admitted:
+                    self._shed(decision)
+                    return
+                req = json.loads(self._body().decode("utf-8"))
+                names = req.get("names", []) if isinstance(req, dict) \
+                    else req
+                self._json(node.leader_delete([str(n) for n in names]))
             elif u.path == "/leader/upload":
                 client, lane = self._client_lane(LANE_BULK)
                 decision = node.admission.admit(client, lane)

@@ -45,6 +45,9 @@ import time
 import zlib
 from typing import Callable, NamedTuple
 
+from tfidf_tpu.cluster.coordination import (CoordinationClient,
+                                            LocalCoordination,
+                                            NoNodeError)
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
@@ -97,6 +100,12 @@ class PlacementMap:
         self.draining: set[str] = set()
         self.gen = 0              # bumped on every replica/moved change
         self._name = name
+        # leadership epoch (cluster/fencing.py), set by the node at
+        # promotion: stamped into the durable znode so the map's
+        # lineage is auditable, and checked at flush time — a deposed
+        # leader's debounced flush must not clobber the successor's
+        # map even in the tiny window before its demotion lands
+        self.epoch: int | None = None
         # ---- persistence ----
         self._flush_s = flush_ms / 1e3 if flush_ms >= 0 else -1.0
         self._coord_getter: Callable | None = None
@@ -113,6 +122,16 @@ class PlacementMap:
         self._stopping = False
         self._wake = threading.Event()
         self._persister: threading.Thread | None = None
+        # flush write-out ORDER lock: serialize-then-write must be
+        # atomic across concurrent flushes (the debounced persister vs
+        # a synchronous delete/flip flush) — otherwise a pre-mutation
+        # snapshot stuck in slow coordination RPCs can overwrite a
+        # later mutation's already-written payload, resurrecting e.g.
+        # an acked delete on the next leader's load (a real lost
+        # update the partition chaos suite caught). Held across the
+        # coordination write BY DESIGN (reviewed; graftcheck
+        # allowlist) — it is a serialization lock no hot path takes.
+        self._flush_serial = threading.Lock()
 
     # ------------------------------------------------------------------
     # routing + upload-leg bookkeeping
@@ -311,17 +330,147 @@ class PlacementMap:
             return {w: frozenset(ns) for w, ns in self.moved.items()
                     if ns}
 
-    def add_replica(self, name: str, worker: str) -> None:
-        """Repair confirmed a new copy of ``name`` on ``worker``."""
+    def forget(self, names: list[str],
+               also: frozenset | set = frozenset()
+               ) -> dict[str, list[str]]:
+        """Client-driven deletion: drop each name from the replica map
+        (scatters stop assigning it an owner immediately) and schedule
+        worker-side deletion through the pending-reconcile (``moved``)
+        machinery — merged results exclude the copies at once, and the
+        sweep retries the deletes until every holder confirms.
+
+        Scheduled on every CONFIRMED holder AND every worker in
+        ``also`` (the caller passes the full live set): a GHOST copy —
+        an upload leg recorded as failed whose request the worker
+        actually processed — is invisible to the map, masked by owner
+        assignment while the name is mapped, and would resurrect
+        through the legacy sum-merge the moment the delete unmaps the
+        name. Blanket scheduling deletes (and excludes) it everywhere;
+        a worker without the doc confirms a zero-row delete and its
+        entry clears.
+
+        Returns ``worker -> names`` scheduled. A concurrent upsert of
+        the same name simply wins (its leg confirmation re-creates the
+        entry): last writer wins, like any upsert race."""
+        out: dict[str, list[str]] = {}
+        changed = False
+        with self.lock:
+            for name in names:
+                reps = self.replicas.pop(name, None)
+                if reps is None and not also:
+                    continue
+                conf = self._confirmed.pop(name, set())
+                targets = set(also)
+                targets.update(w for w in reps or () if w in conf)
+                for w in targets:
+                    self.moved.setdefault(w, set()).add(name)
+                    out.setdefault(w, []).append(name)
+                changed = True
+            if changed:
+                self._owner_cache = None
+                self.gen += 1
+                self._mark_dirty_locked()
+        return out
+
+    def add_replica(self, name: str, worker: str) -> bool:
+        """Repair/migration confirmed a new copy of ``name`` on
+        ``worker``. Returns False ONLY when the map no longer knows the
+        name (deleted mid-copy) — the caller must ``note_stray`` the
+        landed copy so it can neither resurrect through the legacy
+        sum-merge nor linger on the worker's disk."""
         with self.lock:
             reps = self.replicas.get(name)
-            if reps is None or worker in reps:
-                return
+            if reps is None:
+                return False
+            if worker in reps:
+                return True
             self.replicas[name] = reps + (worker,)
             self._confirmed.setdefault(name, set()).add(worker)
             self._unmove_locked(worker, name)
             self.gen += 1
             self._mark_dirty_locked()
+            return True
+
+    def note_stray(self, name: str, worker: str) -> None:
+        """A copy of ``name`` landed on ``worker`` but the map no
+        longer maps the name (a client delete won the race against an
+        in-flight repair/migration copy): schedule the stray for
+        deletion through the pending-reconcile machinery — excluded
+        from merges immediately, removed by the sweep."""
+        with self.lock:
+            reps = self.replicas.get(name)
+            if reps and worker in reps:
+                return   # re-created meanwhile (upsert): legitimate
+            self.moved.setdefault(worker, set()).add(name)
+            self.gen += 1
+            self._mark_dirty_locked()
+        global_metrics.inc("placement_stray_copies")
+
+    def reconcile_residue(self, worker: str, names: list[str],
+                          protected: set[str]
+                          ) -> tuple[list[str], list[str]]:
+        """Anti-entropy for UNMAPPED engine residue: ``names`` is what
+        ``worker``'s engine ACTUALLY serves. A copy the map does not
+        credit to it is partition leftover that owner assignment can
+        only mask, never clean — it silently skews that shard's df/N
+        statistics and resurfaces the moment the name leaves the map:
+
+        - **ghost** (the name is mapped elsewhere, or is pending
+          deletion anywhere): schedule it for deletion from ``worker``
+          through the moved machinery;
+        - **orphan** (the name is mapped nowhere): a write that landed
+          but whose placement was lost to a partition — ADOPT it as a
+          confirmed replica (durability wins: an ambiguous write that
+          survived becomes first-class; the repair pass restores R).
+
+        Names in ``protected`` (mid-migration) or with any in-flight
+        upload leg are skipped — their own machinery owns them.
+        Returns ``(ghosts, orphans)``."""
+        ghosts: list[str] = []
+        orphans: list[str] = []
+        with self.lock:
+            inflight_names = {k[0] for k in self._inflight}
+            for name in names:
+                if name in protected or name in inflight_names:
+                    continue
+                if name in self.moved.get(worker, ()):
+                    continue          # already scheduled away
+                reps = self.replicas.get(name)
+                if reps is not None and worker in reps:
+                    continue          # the map credits this copy
+                pending_anywhere = any(name in ns
+                                       for ns in self.moved.values())
+                if reps is None and not pending_anywhere:
+                    self.replicas[name] = (worker,)
+                    self._confirmed[name] = {worker}
+                    orphans.append(name)
+                else:
+                    self.moved.setdefault(worker, set()).add(name)
+                    ghosts.append(name)
+            if ghosts or orphans:
+                self._owner_cache = None
+                self.gen += 1
+                self._mark_dirty_locked()
+        return ghosts, orphans
+
+    def unplaced_of(self, names, protected: set[str]) -> list[str]:
+        """Names mapped nowhere, pending deletion nowhere, and with no
+        in-flight upload legs — the leader's own-engine orphan check
+        (an ex-worker-turned-leader can hold the ONLY copy of a doc
+        whose placement was lost to a partition; its engine serves no
+        scatter, so the copy is unreachable until re-placed)."""
+        out: list[str] = []
+        with self.lock:
+            inflight_names = {k[0] for k in self._inflight}
+            for name in names:
+                if name in protected or name in inflight_names:
+                    continue
+                if name in self.replicas:
+                    continue
+                if any(name in ns for ns in self.moved.values()):
+                    continue
+                out.append(name)
+        return out
 
     def trim_plan(self, live: set[str], r: int) -> dict[str, list[str]]:
         """Over-replication trim: for every name with more than ``r``
@@ -606,6 +755,13 @@ class PlacementMap:
         (rebound after a session-expiry rejoin)."""
         self._coord_getter = coord_getter
 
+    def _store(self) -> "CoordinationClient | LocalCoordination":
+        """The bound coordination client. A typed accessor so the
+        static lock graph sees the flush's coordination-client edges
+        (the raw ``_coord_getter`` callable is opaque to the resolver
+        — the lockdep witness cross-checks these orderings)."""
+        return self._coord_getter()
+
     def start_persister(self) -> None:
         if self._flush_s < 0 or self._persister is not None:
             return
@@ -666,16 +822,46 @@ class PlacementMap:
                     return False   # not (or no longer) the leader
             except Exception:
                 return False       # can't prove leadership: don't write
-        with self.lock:
-            self._dirty = False
-            payload = self._serialize_locked()
-        global_injector.check("leader.placement_persist")
-        coord = self._coord_getter()
-        coord.ensure(PLACEMENT_NAMESPACE)
-        coord.ensure(PLACEMENT_STATE)
-        coord.set_data(PLACEMENT_STATE, payload)
+        with self._flush_serial:
+            # snapshot + write as one ordered unit (see __init__)
+            with self.lock:
+                self._dirty = False
+                payload = self._serialize_locked()
+            global_injector.check("leader.placement_persist")
+            coord = self._store()
+            if self.epoch is not None and self._fenced_by_stored(coord):
+                return False
+            coord.ensure(PLACEMENT_NAMESPACE)
+            coord.ensure(PLACEMENT_STATE)
+            coord.set_data(PLACEMENT_STATE, payload)
         global_metrics.inc("placement_persists")
         return True
+
+    def _fenced_by_stored(
+            self, coord: "CoordinationClient | LocalCoordination"
+    ) -> bool:
+        """Epoch fence on the durable map itself: when the stored
+        znode carries a HIGHER leadership epoch than ours, a successor
+        already owns the map — skip the write (the persist_gate's
+        is_leader re-check covers the reachable-coordinator case; this
+        covers the race where a deposed leader's flush is already past
+        the gate). Unreadable/absent stored state never blocks: the
+        gate vouched for leadership, so writing is correct."""
+        try:
+            raw = coord.get_data(PLACEMENT_STATE)
+            stored = json.loads(raw.decode()).get("epoch") if raw \
+                else None
+        except NoNodeError:
+            return False
+        except Exception:
+            return False
+        if stored is not None and int(stored) > self.epoch:
+            global_metrics.inc("placement_fence_skips")
+            log.warning("placement flush fenced: durable map belongs "
+                        "to a newer leader", ours=self.epoch,
+                        stored=stored)
+            return True
+        return False
 
     def _serialize_locked(self) -> bytes:
         # only CONFIRMED replicas are durable: a tentative claim whose
@@ -691,6 +877,10 @@ class PlacementMap:
             "replicas": reps,
             "moved": {w: sorted(ns) for w, ns in self.moved.items() if ns},
         }
+        if self.epoch is not None:
+            # the writing leader's fencing epoch: audited by operators,
+            # checked by _fenced_by_stored on every later flush
+            out["epoch"] = self.epoch
         # migration records persist only their durable fields — the
         # unflip bookkeeping ("prior") is same-process-rollback state
         if self.migrations:
@@ -710,8 +900,7 @@ class PlacementMap:
         on this node. Returns the number of documents loaded."""
         if self._coord_getter is None:
             return 0
-        from tfidf_tpu.cluster.coordination import NoNodeError
-        coord = self._coord_getter()
+        coord = self._store()
         try:
             raw = coord.get_data(PLACEMENT_STATE)
         except NoNodeError:

@@ -58,18 +58,29 @@ class RpcStatusError(RuntimeError):
     ``retry_after_s`` carries a 429 shed reply's ``Retry-After`` header
     (the admission layer's honest back-off hint): the retry policy
     never re-attempts BEFORE it has elapsed — see
-    :func:`retry_after_of`."""
+    :func:`retry_after_of`.
+
+    ``fenced`` marks the distinct leadership-fence rejection (403 +
+    ``X-Fence-Rejected: 1``, cluster/fencing.py): the caller's leader
+    epoch is STALE — a newer leader exists. Never retried (the epoch
+    cannot grow back) and never a worker fault (refusing a deposed
+    leader is the worker doing its job); the leader's correct reaction
+    is to step down (``SearchNode._fence_step_down``)."""
 
     def __init__(self, url: str, status: int,
                  deadline_exceeded: bool = False,
-                 retry_after_s: float | None = None) -> None:
+                 retry_after_s: float | None = None,
+                 fenced: bool = False) -> None:
         super().__init__(f"{url} -> {status}"
                          + (" (deadline exceeded)" if deadline_exceeded
+                            else "")
+                         + (" (fenced: stale leader epoch)" if fenced
                             else ""))
         self.url = url
         self.status = status
         self.deadline_exceeded = deadline_exceeded
         self.retry_after_s = retry_after_s
+        self.fenced = fenced
 
 
 class CircuitOpenError(RuntimeError):
@@ -125,6 +136,28 @@ def retry_after_of(e: BaseException) -> float | None:
     return None
 
 
+# the leadership-fence status (cluster/fencing.py): a worker refusing
+# a STALE leader epoch. Distinct from any other 4xx in consequence —
+# the leader must step down, not merely fail the request.
+_FENCE_STATUS = 403
+
+
+def is_fence_rejection(e: BaseException) -> bool:
+    """A worker's leadership-fence rejection (403 +
+    ``X-Fence-Rejected: 1``): the calling leader's epoch is stale.
+    NEVER retryable (a deposed epoch cannot become current again) and
+    NEVER a worker fault (the worker is healthy and doing exactly its
+    job); the leader reacts by stepping down."""
+    if isinstance(e, RpcStatusError):
+        return e.fenced
+    if isinstance(e, urllib.error.HTTPError) and e.code == _FENCE_STATUS:
+        try:
+            return e.headers.get("X-Fence-Rejected") == "1"
+        except Exception:
+            return False
+    return False
+
+
 def is_retryable(e: BaseException) -> bool:
     """Default retry classifier: transient transport failures,
     gateway-transient statuses (502/503/504), and 429 admission sheds
@@ -142,6 +175,8 @@ def is_retryable(e: BaseException) -> bool:
         return False
     if isinstance(e, DeadlineExpired):
         return False   # the budget cannot come back
+    if is_fence_rejection(e):
+        return False   # a stale epoch cannot become current again
     if isinstance(e, FaultInjected):
         return True
     if isinstance(e, RpcStatusError):
@@ -164,7 +199,11 @@ def is_worker_fault(e: BaseException) -> bool:
     5xx — does. A 429 shed falls under the 4xx rule BY DESIGN: shedding
     is healthy overload behavior (cluster/admission.py), and a breaker
     that opened on sheds would amplify the very overload the shed is
-    relieving (fast-fails would mark a live node dead)."""
+    relieving (fast-fails would mark a live node dead). A leadership-
+    fence 403 likewise: the WORKER is healthy — it is the calling
+    leader that is deposed (cluster/fencing.py)."""
+    if is_fence_rejection(e):
+        return False
     if isinstance(e, RpcStatusError):
         if e.deadline_exceeded:
             return False   # honest refusal from a healthy worker
@@ -375,6 +414,25 @@ class CircuitBreaker:
             log.warning("circuit breaker opened", target=self.name,
                         failures=self._failures)
 
+    def trip_slow(self) -> None:
+        """Gray-failure trip: force OPEN now. Called by the latency
+        EWMA when a worker is slow-but-ALIVE — its calls succeed, so
+        consecutive-failure counting never fires, yet every scatter it
+        owns drags to the deadline. The normal half-open probe path
+        re-admits it; the EWMA restarts from scratch on trip (the
+        caller resets it) so one slow era cannot re-condemn a
+        recovered worker forever."""
+        with self._lock:
+            self._probe_inflight = False
+            self._failures = 0
+            if self._state != OPEN:
+                self._transition(OPEN)
+            self._open_until = self._clock() + self.reset_s
+        self._observe("resilience.breaker_trip")
+        global_metrics.inc("breaker_opened")
+        log.warning("circuit breaker opened (gray failure: latency)",
+                    target=self.name)
+
     def _transition(self, state: str) -> None:
         self._state = state
         self.transitions.append(state)
@@ -435,9 +493,14 @@ class BreakerBoard:
 
 
 class ClusterResilience:
-    """The node's resilience bundle: one retry policy + one breaker board,
-    built from :class:`~tfidf_tpu.utils.config.Config` knobs and shared by
+    """The node's resilience bundle: one retry policy + one breaker
+    board + per-worker latency EWMAs (gray-failure detection), built
+    from :class:`~tfidf_tpu.utils.config.Config` knobs and shared by
     every leader→worker RPC path."""
+
+    # EWMA smoothing for the gray-failure detector: ~5-call memory,
+    # heavy enough that one outlier RPC cannot trip a healthy worker
+    _SLOW_ALPHA = 0.2
 
     def __init__(self, config) -> None:
         self.policy = RetryPolicy(
@@ -448,19 +511,79 @@ class ClusterResilience:
         self.board = BreakerBoard(
             failure_threshold=config.breaker_failure_threshold,
             reset_s=config.breaker_reset_s)
+        # gray-failure detection (nemesis latency injection, overloaded
+        # or swapping workers): a slow-but-ALIVE worker never fails a
+        # call, so the consecutive-failure breaker stays closed while
+        # every scatter it owns drags to its deadline. Track a
+        # successful-call latency EWMA per worker and trip the breaker
+        # (breaker_slow_trips) when it crosses the threshold.
+        self.slow_threshold_s = config.breaker_slow_threshold_ms / 1e3
+        self.slow_min_samples = max(1, config.breaker_slow_min_samples)
+        self._lat_lock = threading.Lock()
+        self._lat: dict[str, tuple[float, int]] = {}   # worker -> (ewma, n)
 
-    def worker_call(self, worker: str, fn, retry: bool = True):
+    def prune(self, live) -> None:
+        """Forget breakers AND latency EWMAs for departed workers."""
+        self.board.prune(live)
+        if self.slow_threshold_s > 0:
+            with self._lat_lock:
+                for key in list(self._lat):
+                    if key not in live:
+                        del self._lat[key]
+
+    def _note_latency(self, worker: str, dt_s: float) -> None:
+        if self.slow_threshold_s <= 0:
+            return
+        with self._lat_lock:
+            ewma, n = self._lat.get(worker, (0.0, 0))
+            ewma = dt_s if n == 0 else (
+                self._SLOW_ALPHA * dt_s + (1.0 - self._SLOW_ALPHA) * ewma)
+            n += 1
+            trip = (n >= self.slow_min_samples
+                    and ewma > self.slow_threshold_s)
+            # on trip the EWMA restarts: the half-open probe after
+            # reset_s must judge the worker fresh, not against the
+            # slow era that condemned it
+            self._lat[worker] = (0.0, 0) if trip else (ewma, n)
+        if trip:
+            global_metrics.inc("breaker_slow_trips")
+            log.warning("worker latency EWMA over threshold; tripping "
+                        "breaker (gray failure)", target=worker,
+                        ewma_ms=round(ewma * 1e3, 1),
+                        threshold_ms=round(self.slow_threshold_s * 1e3,
+                                           1))
+            self.board.breaker(worker).trip_slow()
+
+    def worker_call(self, worker: str, fn, retry: bool = True,
+                    track_latency: bool = False):
         """Run one logical RPC against ``worker`` under its breaker.
 
         The breaker admits/rejects the WHOLE logical call; the retry
         policy runs inside it, so a call that succeeds on attempt 2 of 3
         counts as one breaker success, and only a call that exhausts its
         retries counts as one breaker failure. Application rejections
-        (4xx) propagate without indicting the worker."""
+        (4xx) propagate without indicting the worker.
+
+        ``track_latency=True`` feeds the gray-failure EWMA (see
+        ``_note_latency``) — opt-in, for the SCATTER-path call sites
+        only: a single EWMA mixing ms-scale scatter RPCs with
+        legitimately-minutes-long bulk uploads would condemn a healthy
+        worker for doing bulk work. The sample is the successful
+        attempt's OWN duration (measured inside ``fn``'s wrapper), so
+        retry backoff sleeps and failed-attempt timeouts never
+        inflate it."""
         b = self.board.breaker(worker)
         b.acquire()
+        run = fn
+        measured: list[float] = []
+        if track_latency and self.slow_threshold_s > 0:
+            def run() -> object:
+                t0 = time.monotonic()
+                out = fn()
+                measured.append(time.monotonic() - t0)
+                return out
         try:
-            out = self.policy.call(fn) if retry else fn()
+            out = self.policy.call(run) if retry else run()
         except Exception as e:
             if isinstance(e, DeadlineExpired):
                 b.release()   # never dispatched: no evidence either way
@@ -470,4 +593,8 @@ class ClusterResilience:
                 b.record_success()   # a 4xx proves the worker is alive
             raise
         b.record_success()
+        if measured:
+            # AFTER the breaker success accounting: a slow trip fired
+            # here must not be immediately re-closed by it
+            self._note_latency(worker, measured[-1])
         return out

@@ -199,6 +199,14 @@ class Engine:
         with self._write_lock:
             return self.index.delete_document(name)
 
+    def document_names(self) -> list[str] | None:
+        """Names of all live indexed documents, or None when the index
+        layout does not support listing (mesh layouts) — consumed by
+        ``GET /worker/names`` for the leader's residue anti-entropy
+        pass (ghost/orphan reconciliation, cluster/node.py)."""
+        fn = getattr(self.index, "live_names", None)
+        return fn() if fn is not None else None
+
     def remove_document(self, rel: str) -> bool:
         """Delete a document from BOTH the index and the durable docs
         dir — the shard-recovery reconciliation needs both, or a

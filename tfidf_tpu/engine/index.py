@@ -231,6 +231,12 @@ class ShardIndex:
 
     # ---- stats ----
 
+    def live_names(self) -> list[str]:
+        """Names of all live (non-tombstoned) documents — the residue
+        anti-entropy pass compares these against the leader's
+        placement map (cluster/node.py run_residue_reconcile)."""
+        return [d.name for d in self._docs if d.live]
+
     @property
     def num_live_docs(self) -> int:
         return len(self._by_name)

@@ -305,6 +305,12 @@ class SegmentedIndex:
 
     # ---- stats ----
 
+    def live_names(self) -> list[str]:
+        """Names of all live documents (same contract as
+        ``ShardIndex.live_names`` — the residue anti-entropy pass)."""
+        with self._write_lock:
+            return list(self._where)
+
     @property
     def num_live_docs(self) -> int:
         return len(self._where)

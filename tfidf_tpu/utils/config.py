@@ -251,6 +251,11 @@ class Config:
     # loop (the sweep interval is the floor). Negative disables the
     # automatic pass; /api/drain and run_once() still work.
     rebalance_sweep_ms: float = 5000.0
+    # Self-pacing for the residue anti-entropy pass (ghost/orphan
+    # reconciliation of unmapped engine copies left behind by
+    # partitions — cluster/placement.py reconcile_residue). Negative
+    # disables; run_residue_reconcile() still works on demand.
+    residue_sweep_ms: float = 5000.0
 
     # --- coordination durability + quorum (cluster/wal.py, ensemble.py) ---
     # Empty data dir = in-memory substrate (the pre-durability behavior).
@@ -326,6 +331,15 @@ class Config:
     # as degraded, never as a silent empty merge).
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 5.0
+    # Gray-failure detection: a worker whose SUCCESSFUL-call latency
+    # EWMA exceeds this threshold trips its circuit breaker anyway
+    # (counted in breaker_slow_trips) — a slow-but-alive worker never
+    # fails a call, so consecutive-failure counting would let it drag
+    # every scatter it owns to the deadline. 0 disables.
+    breaker_slow_threshold_ms: float = 0.0
+    # Minimum successful samples in the EWMA before a slow trip may
+    # fire (one outlier RPC must not condemn a healthy worker).
+    breaker_slow_min_samples: int = 5
     # Periodic leader sweep retrying failed rejoin reconciles
     # (/worker/delete) so moved documents cannot stay double-indexed
     # until the next membership event; pending names are excluded from

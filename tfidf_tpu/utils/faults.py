@@ -54,6 +54,9 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
                         "shed path itself)",
     "worker.process": "worker handling /worker/process[-batch]",
     "worker.upload": "worker handling /worker/upload[-batch]",
+    "worker.fence": "worker checking a mutating RPC's X-Leader-Epoch "
+                    "against its durable fence (arm to chaos-test the "
+                    "fence path itself)",
     "coord.heartbeat.*": "coordination server receiving a session "
                          "heartbeat (suffix: session id)",
     "coord.heartbeat_send": "coordination client sending a heartbeat",

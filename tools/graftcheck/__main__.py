@@ -22,7 +22,8 @@ import sys
 from tools.graftcheck.core import (BASELINE_PATH, load_allowlist,
                                    load_baseline, run_analyzers, triage)
 
-ANALYZERS = ("lockgraph", "jitpurity", "registry_drift", "resilience")
+ANALYZERS = ("lockgraph", "jitpurity", "registry_drift", "resilience",
+             "wallclock")
 
 
 def main(argv: list[str] | None = None) -> int:

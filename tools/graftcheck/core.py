@@ -541,13 +541,14 @@ def run_analyzers(root: str, analyzers: list[str] | None = None
     """Run the requested analyzers (default: all) over the package at
     ``root``; returns RAW findings (baseline/allowlist not applied)."""
     from tools.graftcheck import (jitpurity, lockgraph, registry_drift,
-                                  resilience)
+                                  resilience, wallclock)
     tree = SourceTree(root)
     passes = {
         "lockgraph": lockgraph.analyze,
         "jitpurity": jitpurity.analyze,
         "registry_drift": lambda t: registry_drift.analyze(t, root),
         "resilience": resilience.analyze,
+        "wallclock": wallclock.analyze,
     }
     out: list[Finding] = []
     for name, fn in passes.items():

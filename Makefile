@@ -55,6 +55,13 @@
 #                     saturating concurrency, per-lane p50/p99 latency,
 #                     shed rate, cache hit rate); writes OVERLOAD.json
 
+#   make trace-demo   zero-to-aha for the tracing layer: spin a small
+#                     in-process cluster, kill a worker mid-request,
+#                     print the rendered trace timeline showing the
+#                     failed scatter.worker span and the scatter.slice
+#                     failover that kept the results complete
+#                     (tools/trace_demo.py)
+
 #   make graftcheck   project-native static analysis (tools/graftcheck):
 #                     lock-graph/deadlock, jit-purity, registry drift,
 #                     resilience coverage — against the committed
@@ -69,7 +76,7 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition faults bench bench-overload \
-        probe-overlap graftcheck lockdep check
+        probe-overlap graftcheck lockdep check trace-demo
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -89,8 +96,12 @@ lockdep:
 	  tests/test_resilience.py tests/test_cluster.py \
 	  tests/test_replication.py tests/test_rebalance.py \
 	  tests/test_admission.py tests/test_partition.py \
+	  tests/test_observability.py \
 	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
+
+trace-demo:
+	JAX_PLATFORMS=cpu python tools/trace_demo.py
 
 check: graftcheck test
 

@@ -733,6 +733,53 @@ def _zipf_indices(rng, pool: int, n: int, s: float = OV_ZIPF_S):
     return rng.choice(pool, size=n, p=w / w.sum())
 
 
+# utils/metrics.py bucket geometry: the live histogram's quantile
+# estimate is within one bucket ratio of truth by construction, so the
+# cross-check tolerance is TWO ratio steps (estimate error on both
+# sides). The server-side histogram measures HANDLER time while the
+# client measures end-to-end, so live may legitimately sit BELOW
+# client by transport/queue overhead — the lower bound therefore only
+# has teeth once the percentile is large enough that overhead is
+# proportionally small; below the floor it is explicitly skipped (and
+# reported as such) instead of being silently neutered by slack.
+_HIST_BUCKET_RATIO = 1.2
+_HIST_LOWER_FLOOR_MS = 50.0
+
+
+def _live_quantile_crosscheck(client_lats_s: list, live_snap: dict
+                              ) -> dict:
+    """Compare bench-measured p50/p99 (client side, every admitted
+    /leader/start across all phases and lanes) against the leader's
+    LIVE histogram quantiles (``leader_search_p50_ms``/``p99_ms`` from
+    the /api/metrics snapshot). Raises — failing the artifact emission
+    — on disagreement beyond bucket-resolution error: an artifact
+    whose live-percentile pipeline cannot reproduce the bench's own
+    distribution is reporting numbers nobody should trust. The UPPER
+    bound (live must not exceed client) always applies — the server
+    cannot see more latency than the client did; the LOWER bound
+    applies only above ``_HIST_LOWER_FLOOR_MS``."""
+    ls = sorted(client_lats_s)
+    if not ls:
+        raise RuntimeError("[ov] no admitted latencies to cross-check")
+    out = {}
+    tol = _HIST_BUCKET_RATIO ** 2
+    for label, q in (("p50", 0.5), ("p99", 0.99)):
+        client_ms = ls[min(len(ls) - 1, int(len(ls) * q))] * 1e3
+        live_ms = float(live_snap.get(f"leader_search_{label}_ms", 0.0))
+        lower_checked = client_ms >= _HIST_LOWER_FLOOR_MS
+        ok = (live_ms > 0.0 and live_ms <= client_ms * tol
+              and (not lower_checked or live_ms >= client_ms / tol))
+        out[label] = {"client_ms": round(client_ms, 1),
+                      "live_ms": round(live_ms, 1),
+                      "lower_bound_checked": lower_checked,
+                      "ok": bool(ok)}
+    if not all(v["ok"] for v in out.values()):
+        raise RuntimeError(
+            f"[ov] live histogram quantiles disagree with the bench's "
+            f"measured distribution beyond bucket resolution: {out}")
+    return out
+
+
 def bench_overload(rng) -> dict:
     """Closed-loop zipfian overload against the admission front door
     (cluster/admission.py): N clients per phase, each posting
@@ -779,6 +826,10 @@ def bench_overload(rng) -> dict:
         return p
 
     client = _KeepAlive()
+    all_lats: list[float] = []   # every admitted /leader/start latency
+    #                              (all phases, both lanes) — compared
+    #                              against the leader's LIVE histogram
+    #                              quantiles after the run
     try:
         coord = _free_port()
         spawn(["coordinator", "--listen", f"127.0.0.1:{coord}"])
@@ -896,6 +947,8 @@ def bench_overload(rng) -> dict:
                     if shed_n else 0.0,
                 }
 
+            all_lats.extend(lats["interactive"])
+            all_lats.extend(lats["bulk"])
             hits = m1.get("cache_hits", 0) - m0.get("cache_hits", 0)
             misses = m1.get("cache_misses", 0) - m0.get("cache_misses",
                                                         0)
@@ -924,8 +977,14 @@ def bench_overload(rng) -> dict:
         one_x = run_phase(1)
         two_x = run_phase(2)
         m = metrics()
+        # cross-validate the LIVE histogram pipeline against the bench's
+        # own measurements while the leader is still up: disagreement
+        # beyond bucket-resolution error fails the artifact emission
+        hist_check = _live_quantile_crosscheck(all_lats, m)
+        log(f"[ov] live-histogram cross-check: {hist_check}")
         return {
             "one_x": one_x, "two_x": two_x,
+            "live_histogram_check": hist_check,
             "p99_ratio_2x_vs_1x": round(
                 two_x["interactive"]["p99_ms"]
                 / one_x["interactive"]["p99_ms"], 2)

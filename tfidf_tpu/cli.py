@@ -13,6 +13,7 @@ The reference ships one Spring Boot fat jar that every node runs
     query        client: search a running cluster
     status       client: node role + live membership + degraded summary
     drain        client: migrate a worker empty before decommission
+    trace        client: fetch + render a distributed request trace
     bench        run the TPU benchmark
     faults       chaos tooling: list registered fault points
 
@@ -492,6 +493,112 @@ def cmd_drain(args) -> int:
     return 1
 
 
+def cmd_trace(args) -> int:
+    """Fetch and render a distributed trace (``GET /api/trace``): by
+    trace id (the ``X-Trace-Id`` reply header every /leader/* response
+    carries, also stamped on slow-query log lines), or the most recent
+    spans. Span rings are PER NODE — a real multi-process cluster keeps
+    the leader-side spans on the leader and the worker-side
+    continuations on each worker — so a by-id fetch fans out to every
+    node in ``/api/services`` and merges (deduping by span id; a
+    one-process test cluster shares one ring). ``--chrome FILE`` writes
+    Chrome-trace/Perfetto JSON instead of the text timeline."""
+    from tfidf_tpu.cluster.node import http_get
+    from tfidf_tpu.utils.tracing import render_trace_tree, to_chrome_trace
+
+    url = _leader_url(args)
+    if args.trace_id:
+        nodes = {url}
+        try:
+            nodes.update(str(u).rstrip("/") for u in json.loads(
+                http_get(url + "/api/services")))
+        except Exception as e:
+            print(f"warning: could not list cluster nodes ({e!r}); "
+                  "rendering this node's spans only", file=sys.stderr)
+        try:
+            # /api/services lists only WORKERS (the leader leaves the
+            # pool on promotion) — but the leader's ring holds the
+            # request/scatter/slice spans, so it must be queried even
+            # when --leader actually points at a worker
+            addr = json.loads(http_get(
+                url + "/api/leader")).get("leader")
+            if addr:
+                nodes.add(str(addr).rstrip("/"))
+        except Exception:
+            pass   # pre-/api/leader node: the entry URL still counts
+        unreachable: set[str] = set()
+
+        def fetch(nu: str, tid: str) -> list[dict]:
+            try:
+                # short per-node budget: the tool's whole point is
+                # tracing through failures, so a partitioned worker
+                # must cost seconds, not the default urlopen timeout
+                got = json.loads(http_get(
+                    nu + "/api/trace/" + urllib.parse.quote(tid),
+                    timeout=3.0))
+            except Exception:
+                unreachable.add(nu)   # a dead worker's spans died
+                return []             # with it — render the rest
+            return got.get("spans", [])
+
+        # two waves: the request id first, then every trace id the
+        # REQUEST's own spans link to (the coalescer boundary —
+        # worker-side continuations live under the BATCH trace id, so
+        # a worker's ring answers only the linked id, not the request
+        # id). The final span set is FILTERED to those resolved ids:
+        # batch spans link every request they absorbed, and the
+        # servers' own one-hop expansion would otherwise pull
+        # unrelated sibling requests into this timeline.
+        from concurrent.futures import ThreadPoolExecutor
+        ordered = sorted(nodes)
+        with ThreadPoolExecutor(min(8, len(ordered))) as pool:
+            wave1_by_node = dict(zip(ordered, pool.map(
+                lambda nu: fetch(nu, args.trace_id), ordered)))
+            wave1 = [s for lst in wave1_by_node.values() for s in lst]
+            ids = {args.trace_id}
+            for s in wave1:
+                if s["trace_id"] == args.trace_id:
+                    ids.update(t["trace_id"]
+                               for t in s.get("links", []))
+            collected = list(wave1)
+            # this wave skips nodes that answered wave 1: their own
+            # one-hop link expansion already covered the linked ids
+            targets = [(nu, tid)
+                       for tid in sorted(ids - {args.trace_id})
+                       for nu in ordered
+                       if not wave1_by_node.get(nu)
+                       and nu not in unreachable]
+            for got in pool.map(lambda t: fetch(*t), targets):
+                collected.extend(got)
+        if unreachable:
+            print("warning: unreachable node(s) skipped: "
+                  + ", ".join(sorted(unreachable)), file=sys.stderr)
+        spans, seen = [], set()
+        for s in collected:
+            if s["trace_id"] in ids and s["span_id"] not in seen:
+                seen.add(s["span_id"])
+                spans.append(s)
+        spans.sort(key=lambda s: s["start_s"])
+    else:
+        data = json.loads(http_get(
+            url + f"/api/trace?recent={int(args.recent)}"))
+        spans = data.get("spans", [])
+    if args.chrome:
+        with open(args.chrome, "w", encoding="utf-8") as f:
+            json.dump(to_chrome_trace(spans), f)
+        print(f"{len(spans)} span(s) -> {args.chrome} "
+              "(load in chrome://tracing or ui.perfetto.dev)")
+        return 0
+    if not spans:
+        print("(no spans"
+              + (f" for trace {args.trace_id}" if args.trace_id else "")
+              + " — is tracing sampled out, or the ring already "
+                "recycled?)")
+        return 1
+    print(render_trace_tree(spans))
+    return 0
+
+
 def cmd_faults(args) -> int:
     """``faults list``: print every fault point compiled into the tree
     (name + firing site) so chaos configs can be checked against the
@@ -608,6 +715,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="poll until the worker is fully drained")
     s.add_argument("--wait-timeout", type=float, default=300.0)
     s.set_defaults(fn=cmd_drain)
+
+    s = sub.add_parser("trace",
+                       help="fetch + render a distributed trace")
+    s.add_argument("trace_id", nargs="?", default="",
+                   help="trace id (X-Trace-Id reply header); omit for "
+                        "the most recent spans")
+    s.add_argument("--leader", required=True, help="any node's base URL")
+    s.add_argument("--recent", type=int, default=100,
+                   help="span count when no trace id is given")
+    s.add_argument("--chrome", metavar="FILE",
+                   help="write Chrome-trace/Perfetto JSON here instead "
+                        "of the text timeline")
+    s.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser("bench", help="run the TPU benchmark")
     s.set_defaults(fn=cmd_bench)

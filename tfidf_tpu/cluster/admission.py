@@ -61,6 +61,7 @@ from dataclasses import dataclass
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.tracing import span_event
 
 log = get_logger("cluster.admission")
 
@@ -161,6 +162,10 @@ class AdmissionController:
         global_metrics.inc("admission_shed_total")
         global_metrics.inc(f"admission_shed_{reason}")
         global_metrics.inc(f"admission_shed_{lane}")
+        # the request span is already active (minted at the handler's
+        # admission point), so a shed is visible in its trace
+        span_event("shed", reason=reason, lane=lane,
+                   retry_after_s=round(retry_after_s, 3))
         return AdmissionDecision(False, retry_after_s, reason)
 
     def admit(self, client: str,

@@ -17,18 +17,21 @@ queries cost one kernel launch instead of B.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
 
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.tracing import current_span, global_tracer
 
 log = get_logger("cluster.batcher")
 
 
 class _Waiter:
-    __slots__ = ("query", "event", "result", "error", "t0", "key", "lane")
+    __slots__ = ("query", "event", "result", "error", "t0", "key",
+                 "lane", "span")
 
     def __init__(self, query, lane: int = 0) -> None:
         self.query = query   # the submitted item (any shape)
@@ -38,6 +41,7 @@ class _Waiter:
         self.t0 = 0.0   # submit time (linger accounting)
         self.key = None  # group key, stamped at SUBMIT time
         self.lane = lane  # 0 = interactive, 1 = bulk (weighted dequeue)
+        self.span = None  # the submitter's active trace span (if any)
 
 
 class Coalescer:
@@ -107,6 +111,13 @@ class Coalescer:
     def submit(self, item, lane: int = 0):
         w = _Waiter(item, lane=1 if lane else 0)
         w.t0 = time.perf_counter()
+        # trace linkage: the batch this item lands in runs on a
+        # dispatcher thread with no request context — capture the
+        # submitter's span so the dispatched batch can LINK (not
+        # parent) the requests it absorbed
+        sp = current_span()
+        if sp is not None and sp.sampled:
+            w.span = sp
         if self.group_key is not None:
             w.key = self.group_key(item)
         with self._lock:
@@ -297,8 +308,28 @@ class Coalescer:
                                  round(waited * 1e3, 3))
         with self._lock:
             self._dispatching += 1
+        # one batch span LINKED (not parented) to every traced request
+        # it absorbed — the Dapper coalescing boundary: the batch serves
+        # N independent traces, so it gets its OWN trace id, and each
+        # request span links forward to it so a trace walk crosses the
+        # boundary in either direction. Untraced batches (no submitter
+        # had an active sampled span) skip tracing entirely.
+        traced = [w.span for w in batch if w.span is not None]
+        # sampled=True, never a re-roll: this root exists only because
+        # the linked requests already won the sampling draw — an
+        # independent draw would drop their scatter sub-trace with
+        # probability (1 - sample_rate)
+        batch_cm = (global_tracer.span(
+            f"{self.name}.batch", sampled=True,
+            links=[s.context for s in traced],
+            attrs={"items": len(batch), "linked": len(traced)})
+            if traced else contextlib.nullcontext())
         try:
-            results = self.batch_fn([w.query for w in batch])
+            with batch_cm as bsp:
+                if bsp is not None:
+                    for s in traced:
+                        s.add_link(bsp.context)
+                results = self.batch_fn([w.query for w in batch])
             for w, r in zip(batch, results):
                 w.result = r
         except Exception as e:

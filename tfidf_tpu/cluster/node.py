@@ -32,6 +32,7 @@ the scatter fan-out is parallel (the reference loops serially,
 
 from __future__ import annotations
 
+import contextlib
 import email.parser
 import email.policy
 import json
@@ -60,7 +61,9 @@ from tfidf_tpu.cluster.fencing import (FENCE_EPOCH_HEADER, FENCE_HEADER,
 from tfidf_tpu.cluster.nemesis import global_nemesis
 from tfidf_tpu.cluster.placement import PlacementMap
 from tfidf_tpu.cluster.rebalance import Rebalancer
-from tfidf_tpu.cluster.registry import (ServiceRegistry, publish_leader_info)
+from tfidf_tpu.cluster.registry import (ServiceRegistry,
+                                        publish_leader_info,
+                                        read_leader_info)
 from tfidf_tpu.cluster.resilience import (CircuitOpenError,
                                           ClusterResilience,
                                           DeadlineExpired, RpcStatusError,
@@ -72,6 +75,10 @@ from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.tracing import (SPAN_HEADER, TRACE_HEADER,
+                                     global_tracer, propagation_headers,
+                                     remote_context, span_event,
+                                     to_chrome_trace)
 
 log = get_logger("cluster.node")
 
@@ -82,11 +89,18 @@ log = get_logger("cluster.node")
 # shim (cluster/nemesis.py): an ``origin`` identifies the calling node
 # so tests can script per-link partitions/latency/corruption without
 # monkeypatching any call site. No rules armed = one emptiness check.
+# They are ALSO the trace-propagation seams: when the calling thread
+# has an active span, its X-Trace-Id/X-Span-Id ride every outbound
+# request (explicit caller headers win on collision), so the trace
+# context crosses every leader->worker RPC by construction.
 
 def http_get(url: str, timeout: float = 10.0,
              origin: str | None = None) -> bytes:
     global_nemesis.check_send(origin, url)
-    with urllib.request.urlopen(url, timeout=timeout) as r:
+    trace_h = propagation_headers()
+    req = urllib.request.Request(url, headers=trace_h) if trace_h \
+        else url
+    with urllib.request.urlopen(req, timeout=timeout) as r:
         return global_nemesis.filter_reply(origin, url, r.read())
 
 
@@ -165,6 +179,7 @@ class _ScatterClient:
                                       _socket.TCP_NODELAY, 1)
                     conns[base] = c
                 h = {"Content-Type": "application/json"}
+                h.update(propagation_headers())
                 h.update(headers or {})
                 c.request("POST", path, body=data, headers=h)
                 r = c.getresponse()
@@ -208,6 +223,7 @@ def http_post(url: str, data: bytes, content_type: str = "application/json",
               origin: str | None = None) -> bytes:
     global_nemesis.check_send(origin, url)
     h = {"Content-Type": content_type}
+    h.update(propagation_headers())
     h.update(headers or {})
     req = urllib.request.Request(url, data=data, headers=h)
     with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -254,6 +270,12 @@ class SearchNode:
         ``notifyAll``s on disconnect, ``app/Application.java:49-66``; an
         expired node stays out of the cluster until the pod restarts)."""
         self.config = config or Config()
+        # distributed tracing knobs (utils/tracing.py): ring bound +
+        # root sampling rate. The tracer is process-global (like the
+        # metrics registry); in-process test clusters share one ring.
+        global_tracer.configure(
+            max_spans=self.config.trace_ring_spans,
+            sample_rate=self.config.trace_sample_rate)
         if coord is None and coord_factory is not None:
             coord = coord_factory()
         assert coord is not None, "a coordination client is required"
@@ -973,6 +995,8 @@ class SearchNode:
                     worker=worker, err=repr(e),
                     my_epoch=self._leader_epoch)
         global_metrics.inc("fence_step_downs")
+        span_event("fence_rejected", worker=worker,
+                   stale_epoch=self._leader_epoch)
         threading.Thread(target=self._fence_step_down, daemon=True,
                          name=f"fence-stepdown-{self.port}").start()
 
@@ -1207,12 +1231,19 @@ class SearchNode:
 
     def _slice_call(self, addr: str, queries: list[str],
                     names: list[str], t_deadline: float,
-                    live: set[str]) -> list[list[tuple[str, float]]]:
+                    live: set[str], trace_parent=None,
+                    kind: str = "failover"
+                    ) -> list[list[tuple[str, float]]]:
         """Failover / hedged read: score the ``names`` ownership slice
         on a surviving replica (one breaker-gated, retried logical
         RPC). Exact within the slice — the worker computes the full
         ranking host-side and filters, so no slice document can be
-        truncated out by documents outside it."""
+        truncated out by documents outside it.
+
+        ``trace_parent`` parents the slice span under the scatter span
+        that dispatched it (the slice pool thread has no ambient
+        context); ``kind`` distinguishes a failover re-issue from a
+        hedged duplicate in the trace."""
         def rpc() -> list[list[tuple[str, float]]]:
             global_injector.check("leader.replica_rpc")
             remaining = t_deadline - time.monotonic()
@@ -1225,8 +1256,18 @@ class SearchNode:
                 timeout=remaining, live=live,
                 headers={"X-Deadline-Ms": str(int(remaining * 1e3))})
             return unpack_hit_lists(raw)
-        return self.resilience.worker_call(addr, rpc,
-                                           track_latency=True)
+
+        def run():
+            return self.resilience.worker_call(addr, rpc,
+                                               track_latency=True)
+
+        if trace_parent is None:
+            return run()
+        with global_tracer.span(
+                "scatter.slice", parent=trace_parent,
+                attrs={"worker": addr, "kind": kind,
+                       "names": len(names)}):
+            return run()
 
     def _gather_merge(self, queries: list[str], rpc_one,
                       t_deadline: float
@@ -1259,14 +1300,29 @@ class SearchNode:
         open_set = frozenset(w for w in workers
                              if self.resilience.board.is_open(w))
         view = self.placement.owner_assignment(frozenset(live), open_set)
+        # the scatter span this request (or its coalesced batch) is
+        # running under: per-worker RPCs become CHILD spans of it, and
+        # failover/hedge slices parent under it too (the pool threads
+        # have no ambient context of their own). None = untraced; every
+        # tracing call below no-ops.
+        tparent = global_tracer.current()
+        if tparent is not None and not tparent.sampled:
+            tparent = None
 
         def call(addr: str):
             # scatter RPCs feed the gray-failure latency EWMA (slow
             # worker detection is scoped to THIS path — bulk uploads
             # legitimately take minutes and must not condemn a worker)
-            return self.resilience.worker_call(
-                addr, lambda: rpc_one(addr, live, t_deadline),
-                track_latency=True)
+            def run():
+                return self.resilience.worker_call(
+                    addr, lambda: rpc_one(addr, live, t_deadline),
+                    track_latency=True)
+            if tparent is None:
+                return run()
+            with global_tracer.span("scatter.worker", parent=tparent,
+                                    attrs={"worker": addr,
+                                           "queries": len(queries)}):
+                return run()
 
         futures = {self._pool.submit(call, w): w for w in workers}
 
@@ -1281,13 +1337,15 @@ class SearchNode:
                     return
                 global_injector.check("leader.hedge")
                 global_metrics.inc("scatter_hedges")
+                if tparent is not None:
+                    tparent.event("hedge_dispatched", laggard=addr)
                 for backup, ns in self.placement.backups_for(
                         names, exclude={addr}, live=live,
                         avoid=open_set).items():
                     hedge_futs.setdefault(addr, []).append(
                         (backup, ns, self._slice_pool.submit(
                             self._slice_call, backup, queries, ns,
-                            t_deadline, live)))
+                            t_deadline, live, tparent, "hedge")))
             hedge_laggards(dict(futures),
                            self.config.scatter_hedge_ms / 1e3,
                            dispatch_hedge)
@@ -1357,6 +1415,8 @@ class SearchNode:
                     for _b, _ns, hf in hedge_futs.get(addr, ()))
                 if won:
                     global_metrics.inc("scatter_hedge_wins")
+                    if tparent is not None:
+                        tparent.event("hedge_win", laggard=addr)
                     log.info("hedge superseded laggard primary",
                              worker=addr)
                 else:
@@ -1467,7 +1527,7 @@ class SearchNode:
                 fresh_pending = [
                     (backup, ns, self._slice_pool.submit(
                         self._slice_call, backup, queries, ns,
-                        t_deadline, live))
+                        t_deadline, live, tparent, "failover"))
                     for backup, ns in self.placement.backups_for(
                         fresh, exclude=failed | failed_backups,
                         live=live, avoid=open_set).items()]
@@ -1495,6 +1555,10 @@ class SearchNode:
             len(workers), len(ok), circuit_open,
             failovers=len(recovered), dark=dark,
             uncovered_workers=uncovered_workers)
+        if tparent is not None:
+            # the request story's verdict, on the scatter span itself:
+            # chaos suites assert degraded/failover counts from here
+            tparent.event("scatter.health", **health)
         return merged, health
 
     # ---- shard recovery (SURVEY §5.3 — beyond the reference) ----
@@ -2556,8 +2620,17 @@ class _NodeHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
+        headers = headers or {}
+        for k, v in headers.items():
             self.send_header(k, v)
+        # every response produced inside a request span carries its
+        # trace id — uploads, deletes, downloads, and 429 sheds
+        # included, not just /leader/start (the documented contract:
+        # any /leader/* reply's X-Trace-Id keys `tfidf_tpu trace`)
+        if TRACE_HEADER not in headers:
+            sp = global_tracer.current()
+            if sp is not None:
+                self.send_header(TRACE_HEADER, sp.trace_id)
         self.end_headers()
         self.wfile.write(body)
 
@@ -2582,6 +2655,61 @@ class _NodeHandler(BaseHTTPRequestHandler):
         if ctype.startswith("multipart/form-data"):
             return _parse_multipart(body, ctype)
         return self._query_param(u, "name"), body
+
+    # ---- tracing plumbing (utils/tracing.py) ----
+
+    def _remote_ctx(self, trusted: bool):
+        """The propagated trace context from the request headers, or
+        None for an untraced request. ``trusted`` distinguishes the
+        leader→worker continuation (sampling decided upstream) from
+        front-door headers (subject to this node's own draw)."""
+        return remote_context(self.headers.get(TRACE_HEADER),
+                              self.headers.get(SPAN_HEADER),
+                              trusted=trusted)
+
+    @contextlib.contextmanager
+    def _request_span(self, name: str, **attrs):
+        """Span for one handled front-door request: keeps the caller's
+        trace id when headers are present (UNTRUSTED — recording still
+        subject to this node's sampling draw), else mints a new ROOT
+        trace — the admission point where every client request's
+        trace id is born. The span is remembered on the handler so the
+        outer 500 path can still stamp the reply/log with the trace id
+        AFTER the contextvar is reset (failed requests are the ones
+        operators most need to trace)."""
+        with global_tracer.span(
+                name, parent=self._remote_ctx(trusted=False),
+                attrs=attrs or None) as sp:
+            self._last_span = sp
+            yield sp
+
+    def _worker_span(self, name: str, **attrs):
+        """Worker-endpoint span: created ONLY when the caller sent a
+        trace context (the leader's propagated scatter — trusted, the
+        sampling decision was made at the root). External/reference
+        clients (and local benches) hitting /worker/* directly stay
+        untraced — the worker plane adds zero per-request tracing cost
+        unless the leader asked."""
+        ctx = self._remote_ctx(trusted=True)
+        if ctx is None:
+            return contextlib.nullcontext()
+        return global_tracer.span(name, parent=ctx, attrs=attrs or None)
+
+    @contextlib.contextmanager
+    def _admitted(self, name: str, default_lane: str):
+        """The front-door prologue every /leader/* handler shares:
+        resolve the client lane, open the request span, admit-or-shed
+        BEFORE the body is read or any work queues. Yields
+        ``(span, lane)`` when admitted; ``(None, lane)`` when the shed
+        reply was already sent (the caller just returns)."""
+        client, lane = self._client_lane(default_lane)
+        with self._request_span(name, lane=lane) as sp:
+            decision = self.node.admission.admit(client, lane)
+            if not decision.admitted:
+                self._shed(decision)
+                yield None, lane
+            else:
+                yield sp, lane
 
     def _deadline_header(self) -> float | None:
         """``X-Deadline-Ms`` (the leader's remaining scatter budget) as
@@ -2715,6 +2843,7 @@ class _NodeHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         u = urllib.parse.urlparse(self.path)
         node = self.node
+        self._last_span = None
         try:
             if u.path == "/api/health":
                 # the reserved observability lane: never admission-
@@ -2741,27 +2870,39 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 # the front door guards every /leader/* endpoint:
                 # checkpoint downloads are bulk transfers (real file
                 # I/O per request), first to shed under backpressure
-                client, lane = self._client_lane(LANE_BULK)
-                decision = node.admission.admit(client, lane)
-                if not decision.admitted:
-                    self._shed(decision)
-                    return
-                rel = urllib.parse.unquote(self._query_param(u, "path") or "")
-                try:
-                    got = node.leader_download_stream(rel)
-                except PermissionError:
-                    self._text("invalid path", 400)
-                    return
-                if got is None:
-                    self._text("not found", 404)
-                else:
-                    self._stream(*got)
+                with self._admitted("leader.download",
+                                    LANE_BULK) as (sp, _lane):
+                    if sp is None:
+                        return
+                    rel = urllib.parse.unquote(
+                        self._query_param(u, "path") or "")
+                    sp.set_attr("file", rel)
+                    try:
+                        got = node.leader_download_stream(rel)
+                    except PermissionError:
+                        self._text("invalid path", 400)
+                        return
+                    if got is None:
+                        self._text("not found", 404)
+                    else:
+                        self._stream(*got)
             elif u.path == "/api/status":
                 # same phrasing as Controllers.java:25-29
                 self._text("I am the leader" if node.is_leader()
                            else "I am a worker node")
             elif u.path == "/api/services":
                 self._json(node.registry.get_all_service_addresses())
+            elif u.path == "/api/leader":
+                # the published /leader_info znode over HTTP: the
+                # leader leaves the worker pool on promotion, so
+                # /api/services alone cannot name it — clients (and
+                # the CLI trace fan-out, whose request spans live in
+                # the LEADER's ring) discover it here from any node
+                try:
+                    addr = read_leader_info(node.coord)
+                except Exception:
+                    addr = None
+                self._json({"leader": addr})
             elif u.path == "/api/drain":
                 # drain progress for one worker. Leader-only like the
                 # POST: a follower's placement map is reset on demotion,
@@ -2776,7 +2917,21 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._text("missing worker", 400)
                     return
                 self._json(node.rebalancer.drain_status(worker))
-            elif u.path == "/api/metrics":
+            elif u.path in ("/api/metrics", "/metrics"):
+                # /metrics is the conventional Prometheus scrape path
+                # (deploy/k8s.yaml annotates it); /api/metrics keeps
+                # the ad-hoc JSON and answers ?format=prometheus too.
+                # Neither is admission-controlled (observability lane).
+                fmt = self._query_param(u, "format")
+                if u.path == "/metrics" or fmt == "prometheus":
+                    body = global_metrics.render_prometheus(
+                        extra_gauges={
+                            "breaker_open_workers_now":
+                                node.resilience.board.open_count()})
+                    self._send(body=body.encode(), code=200,
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    return
                 snap = global_metrics.snapshot()
                 # live per-worker breaker states beside the counters —
                 # the CLI's degraded summary reads these
@@ -2784,15 +2939,50 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 if states:
                     snap["breaker_states"] = states
                 self._json(snap)
+            elif u.path == "/api/trace" or u.path.startswith(
+                    "/api/trace/"):
+                # trace export (observability lane, never admission-
+                # controlled): /api/trace/<trace-id> reconstructs one
+                # request's story (link-following pulls in the batch
+                # trace it coalesced into); /api/trace?recent=N lists
+                # the newest finished spans. ?format=chrome renders
+                # Chrome-trace/Perfetto JSON.
+                tid = u.path[len("/api/trace/"):] \
+                    if u.path.startswith("/api/trace/") else \
+                    (self._query_param(u, "id") or "")
+                if tid:
+                    spans = global_tracer.get_trace(tid)
+                else:
+                    try:
+                        n = int(self._query_param(u, "recent") or 100)
+                    except ValueError:
+                        n = 100
+                    spans = global_tracer.recent(n)
+                if self._query_param(u, "format") == "chrome":
+                    self._json(to_chrome_trace(spans))
+                else:
+                    self._json({"trace_id": tid or None,
+                                "spans": spans})
             else:
                 self._text("not found", 404)
         except Exception as e:
-            log.warning("request failed", path=u.path, err=repr(e))
-            self._text(f"error: {e!r}", 500)
+            # the request span's contextvar is gone by now; the
+            # remembered span keys the error reply + log line so a
+            # FAILED request stays joinable with its recorded
+            # (error-attributed) span
+            sp = self._last_span
+            kv = {"trace": sp.trace_id} if sp is not None else {}
+            log.warning("request failed", path=u.path, err=repr(e),
+                        **kv)
+            self._send(500, f"error: {e!r}".encode(),
+                       "text/plain; charset=utf-8",
+                       headers={TRACE_HEADER: sp.trace_id}
+                       if sp is not None else None)
 
     def do_POST(self) -> None:
         u = urllib.parse.urlparse(self.path)
         node = self.node
+        self._last_span = None
         try:
             if u.path == "/worker/process":
                 # same deadline refusal as the batched endpoint: the
@@ -2805,7 +2995,8 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 global_injector.check("worker.process")
                 query = self._read_query()
                 try:
-                    hits = node.worker_search(query)
+                    with self._worker_span("worker.process"):
+                        hits = node.worker_search(query)
                 except Exception as e:
                     # reference returns [] on any failure (Worker.java:183)
                     log.warning("search failed", err=repr(e))
@@ -2836,14 +3027,24 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 k = req.get("k")
                 names = req.get("names")
                 try:
-                    if names is not None:
-                        body = pack_hit_lists(node.worker_search_slice(
-                            queries, [str(n) for n in names],
-                            deadline=deadline))
-                    else:
-                        body = node.worker_search_batch_wire(
-                            queries, k=int(k) if k is not None else None,
-                            deadline=deadline)
+                    # continues the leader's scatter trace (propagated
+                    # headers); the engine's trace_phase events and the
+                    # pipeline stage events land inside this span
+                    with self._worker_span(
+                            "worker.process_batch",
+                            queries=len(queries),
+                            slice=len(names) if names is not None
+                            else 0):
+                        if names is not None:
+                            body = pack_hit_lists(
+                                node.worker_search_slice(
+                                    queries, [str(n) for n in names],
+                                    deadline=deadline))
+                        else:
+                            body = node.worker_search_batch_wire(
+                                queries,
+                                k=int(k) if k is not None else None,
+                                deadline=deadline)
                 except WorkerDeadline as e:
                     self._send(504, f"{e}".encode(),
                                "text/plain; charset=utf-8",
@@ -2958,82 +3159,116 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 # search latency (admit BEFORE reading the body — a
                 # shed upload pays at most the 1 MB drain in _shed,
                 # never a JSON parse or an index slot)
-                client, lane = self._client_lane(LANE_BULK)
-                decision = node.admission.admit(client, lane)
-                if not decision.admitted:
-                    self._shed(decision)
-                    return
-                docs = json.loads(self._body().decode("utf-8"))
-                try:
-                    self._json(node.leader_upload_batch(docs))
-                except ValueError as e:   # malformed client payload
-                    self._text(str(e), 400)
+                with self._admitted("leader.upload_batch",
+                                    LANE_BULK) as (sp, _lane):
+                    if sp is None:
+                        return
+                    docs = json.loads(self._body().decode("utf-8"))
+                    sp.set_attr("docs", len(docs)
+                                if isinstance(docs, list) else 0)
+                    try:
+                        self._json(node.leader_upload_batch(docs))
+                    except ValueError as e:  # malformed client payload
+                        self._text(str(e), 400)
             elif u.path == "/leader/start":
                 # front-door admission BEFORE any work is queued: a
                 # shed request costs one token-bucket check, not a
                 # coalescer slot (searches default to the interactive
                 # lane; X-Priority: bulk selects the bulk lane, which
-                # backpressure sheds first)
-                client, lane = self._client_lane(LANE_INTERACTIVE)
-                decision = node.admission.admit(client, lane)
-                if not decision.admitted:
-                    self._shed(decision)
-                    return
-                query = self._read_query()
-                result, health = node.leader_search_with_health(
-                    query, lane=lane)
-                # degraded marker: the body stays reference-compatible
-                # (name -> score), the header says whether every live
-                # worker's shard is represented in it
-                hdrs = None
-                if health.get("degraded"):
-                    hdrs = {"X-Scatter-Degraded":
-                            "attempted={attempted} responded={responded} "
+                # backpressure sheds first). The request's trace span
+                # is minted HERE — the admission point — so even a
+                # shed request has a trace id, and the span is active
+                # through admission/cache/coalesce/scatter beneath.
+                t0 = time.perf_counter()
+                with self._admitted("leader.search",
+                                    LANE_INTERACTIVE) as (sp, lane):
+                    if sp is None:
+                        return
+                    query = self._read_query()
+                    result, health = node.leader_search_with_health(
+                        query, lane=lane)
+                    # degraded marker: the body stays reference-
+                    # compatible (name -> score); the headers say
+                    # whether every live worker's shard is represented
+                    # and which trace reconstructs this request
+                    hdrs = {TRACE_HEADER: sp.trace_id}
+                    if health.get("cached"):
+                        sp.set_attr("cached", 1)
+                    sp.set_attr("degraded", health.get("degraded", 0))
+                    if health.get("degraded"):
+                        hdrs["X-Scatter-Degraded"] = (
+                            "attempted={attempted} "
+                            "responded={responded} "
                             "circuit_open={circuit_open} "
                             "failovers={failovers} dark={dark}"
                             .format(failovers=health.get("failovers", 0),
                                     dark=health.get("dark", 0), **{
                                         k: health[k] for k in
                                         ("attempted", "responded",
-                                         "circuit_open")})}
-                self._json(result, headers=hdrs)
+                                         "circuit_open")}))
+                    dt = time.perf_counter() - t0
+                    # live front-door latency histogram: the p50/p99
+                    # operators (and bench.py's cross-validation) read
+                    global_metrics.observe("leader_search", dt)
+                    slow_ms = node.config.trace_slow_query_ms
+                    if slow_ms > 0 and dt * 1e3 >= slow_ms:
+                        # trace-id-keyed slow-query log: the adapter
+                        # stamps trace=<id> (the span is active here),
+                        # so this line joins with /api/trace/<id>
+                        global_metrics.inc("slow_queries")
+                        log.warning(
+                            "slow query", ms=round(dt * 1e3, 1),
+                            query=query[:80],
+                            degraded=health.get("degraded", 0))
+                    self._json(result, headers=hdrs)
             elif u.path == "/leader/delete":
                 # placement-aware cluster-wide deletion (the upsert/
                 # delete/search partition workload's delete leg); bulk
                 # lane like every other mutating front-door endpoint
-                client, lane = self._client_lane(LANE_BULK)
-                decision = node.admission.admit(client, lane)
-                if not decision.admitted:
-                    self._shed(decision)
-                    return
-                req = json.loads(self._body().decode("utf-8"))
-                names = req.get("names", []) if isinstance(req, dict) \
-                    else req
-                self._json(node.leader_delete([str(n) for n in names]))
-            elif u.path == "/leader/upload":
-                client, lane = self._client_lane(LANE_BULK)
-                decision = node.admission.admit(client, lane)
-                if not decision.admitted:
-                    self._shed(decision)
-                    return
-                name, data = self._read_upload(u)
-                if not name:
-                    self._text("missing file name", 400)
-                    return
-                try:
-                    result = node.leader_upload(name, data)
-                except urllib.error.HTTPError as e:
-                    if e.code == 415:   # worker refused a binary format
-                        self._text("unsupported media type", 415)
+                with self._admitted("leader.delete",
+                                    LANE_BULK) as (sp, _lane):
+                    if sp is None:
                         return
-                    raise
-                self._text(f"File uploaded successfully to worker: "
-                           f"{result['worker']}")
+                    req = json.loads(self._body().decode("utf-8"))
+                    names = req.get("names", []) \
+                        if isinstance(req, dict) else req
+                    sp.set_attr("names", len(names))
+                    self._json(node.leader_delete(
+                        [str(n) for n in names]))
+            elif u.path == "/leader/upload":
+                with self._admitted("leader.upload",
+                                    LANE_BULK) as (sp, _lane):
+                    if sp is None:
+                        return
+                    name, data = self._read_upload(u)
+                    if not name:
+                        self._text("missing file name", 400)
+                        return
+                    sp.set_attr("file", name)
+                    try:
+                        result = node.leader_upload(name, data)
+                    except urllib.error.HTTPError as e:
+                        if e.code == 415:  # worker refused the format
+                            self._text("unsupported media type", 415)
+                            return
+                        raise
+                    self._text(f"File uploaded successfully to worker: "
+                               f"{result['worker']}")
             else:
                 self._text("not found", 404)
         except Exception as e:
-            log.warning("request failed", path=u.path, err=repr(e))
-            self._text(f"error: {e!r}", 500)
+            # the request span's contextvar is gone by now; the
+            # remembered span keys the error reply + log line so a
+            # FAILED request stays joinable with its recorded
+            # (error-attributed) span
+            sp = self._last_span
+            kv = {"trace": sp.trace_id} if sp is not None else {}
+            log.warning("request failed", path=u.path, err=repr(e),
+                        **kv)
+            self._send(500, f"error: {e!r}".encode(),
+                       "text/plain; charset=utf-8",
+                       headers={TRACE_HEADER: sp.trace_id}
+                       if sp is not None else None)
 
     _STREAM_CHUNK = 1 << 16
 
@@ -3051,6 +3286,9 @@ class _NodeHandler(BaseHTTPRequestHandler):
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/octet-stream")
+            sp = global_tracer.current()
+            if sp is not None:   # stream replies bypass _send; same
+                self.send_header(TRACE_HEADER, sp.trace_id)  # contract
             chunked = size is None
             if chunked:
                 self.send_header("Transfer-Encoding", "chunked")

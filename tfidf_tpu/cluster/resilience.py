@@ -39,6 +39,7 @@ from typing import Callable
 from tfidf_tpu.utils.faults import FaultInjected, global_injector
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.tracing import span_event
 
 log = get_logger("cluster.resilience")
 
@@ -269,6 +270,11 @@ class RetryPolicy:
                         and self._clock() - t0 + delay > self.deadline_s):
                     raise   # the budget is spent; honest failure now
                 global_metrics.inc(f"{self.name}_retries")
+                # visible in the request trace: which attempt failed,
+                # with what, and how long the backoff slept
+                span_event("retry", attempt=attempt,
+                           delay_ms=round(delay * 1e3, 1),
+                           err=repr(e)[:120])
                 global_injector.check("resilience.backoff")
                 self._sleep(delay)
         raise AssertionError("unreachable")   # loop always returns/raises
@@ -411,6 +417,7 @@ class CircuitBreaker:
         if tripped:
             self._observe("resilience.breaker_trip")
             global_metrics.inc("breaker_opened")
+            span_event("breaker_trip", target=self.name)
             log.warning("circuit breaker opened", target=self.name,
                         failures=self._failures)
 
@@ -430,6 +437,7 @@ class CircuitBreaker:
             self._open_until = self._clock() + self.reset_s
         self._observe("resilience.breaker_trip")
         global_metrics.inc("breaker_opened")
+        span_event("breaker_trip", target=self.name, gray=1)
         log.warning("circuit breaker opened (gray failure: latency)",
                     target=self.name)
 

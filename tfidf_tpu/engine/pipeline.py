@@ -44,11 +44,13 @@ from __future__ import annotations
 
 import atexit
 import threading
+import time
 import weakref
 from collections import deque
 from concurrent.futures import Future
 
 from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.tracing import current_span, global_tracer
 
 # Every live executor, stopped at interpreter exit: a daemon thread
 # reaped DURING finalization while inside XLA's C++ fetch path dies via
@@ -71,12 +73,19 @@ atexit.register(_stop_all_executors)
 
 
 class _Job:
-    __slots__ = ("dispatch", "fetch", "future")
+    __slots__ = ("dispatch", "fetch", "future", "span")
 
-    def __init__(self, dispatch, fetch, future: Future) -> None:
+    def __init__(self, dispatch, fetch, future: Future,
+                 span=None) -> None:
         self.dispatch = dispatch
         self.fetch = fetch
         self.future = future
+        # the SUBMITTER's active trace span: the stage threads have no
+        # request context of their own, so each stage re-activates this
+        # span while running — pipeline.dispatch/fetch events (and the
+        # engine's trace_phase events inside dispatch) land on the
+        # request timeline they belong to
+        self.span = span
 
 
 class PipelineExecutor:
@@ -119,10 +128,13 @@ class PipelineExecutor:
         returns a state tuple; ``fetch(*state)`` performs the d2h
         transfer and returns the future's result."""
         fut: Future = Future()
+        sp = current_span()
+        if sp is not None and not sp.sampled:
+            sp = None
         with self._lock:
             if self._stopping:
                 raise RuntimeError(f"{self.name} executor stopped")
-            self._dispatch_q.append(_Job(dispatch, fetch, fut))
+            self._dispatch_q.append(_Job(dispatch, fetch, fut, sp))
             self._ensure_threads_locked()
             self._work.notify()
         return fut
@@ -181,7 +193,13 @@ class PipelineExecutor:
             if not job.future.set_running_or_notify_cancel():
                 continue   # cancelled (an earlier sibling failed)
             try:
-                state = job.dispatch()
+                t0 = time.perf_counter()
+                with global_tracer.activate(job.span):
+                    state = job.dispatch()
+                if job.span is not None:
+                    job.span.event(
+                        "pipeline.dispatch", stage=self.name,
+                        ms=round((time.perf_counter() - t0) * 1e3, 3))
             except BaseException as e:
                 global_metrics.inc(f"{self.name}_dispatch_failures")
                 job.future.set_exception(e)
@@ -219,7 +237,13 @@ class PipelineExecutor:
                 job, state = self._fetch_q.popleft()
                 self._fetch_busy = 1
             try:
-                job.future.set_result(job.fetch(*state))
+                t0 = time.perf_counter()
+                with global_tracer.activate(job.span):
+                    job.future.set_result(job.fetch(*state))
+                if job.span is not None:
+                    job.span.event(
+                        "pipeline.fetch", stage=self.name,
+                        ms=round((time.perf_counter() - t0) * 1e3, 3))
             except BaseException as e:
                 global_metrics.inc(f"{self.name}_fetch_failures")
                 job.future.set_exception(e)

@@ -350,6 +350,21 @@ class Config:
     # bucket) stops being retried once the bucket's budget is spent.
     compile_retry_per_bucket: int = 2
 
+    # --- observability (utils/tracing.py, utils/metrics.py) ---
+    # Bound on the in-process span ring buffer (finished spans kept for
+    # GET /api/trace). Appends are GIL-atomic deque ops — the bound is
+    # memory, not locking.
+    trace_ring_spans: int = 4096
+    # Fraction of ROOT traces sampled into the ring (children and
+    # remote continuations inherit the decision). 1.0 records every
+    # request; 0 disables recording and propagation while keeping trace
+    # ids on the local node's log lines (correlation without retention).
+    trace_sample_rate: float = 1.0
+    # Threshold for the slow-query log: a /leader/start request slower
+    # than this logs one warn line carrying its trace id (joinable with
+    # /api/trace) and counts in `slow_queries`. 0 disables.
+    trace_slow_query_ms: float = 0.0
+
     # --- ingest ---
     # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back
     # to the pure-Python analyzer when no compiler is available or for

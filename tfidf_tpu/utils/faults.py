@@ -23,6 +23,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from tfidf_tpu.utils.tracing import span_event
+
 # Registry of every fault point compiled into the tree: name -> where it
 # fires. Dynamic per-instance points are declared with a ``*`` suffix.
 KNOWN_FAULT_POINTS: dict[str, str] = {
@@ -140,6 +142,12 @@ class FaultInjector:
             # configs can assert totals without enumerating instances
             self.fired[key] = self.fired.get(key, 0) + 1
             action, delay_s, fn = rule.action, rule.delay_s, rule.fn
+        # every fault fire is visible in traces BY CONSTRUCTION: the one
+        # emission here covers all fault_point()/check() sites (enforced
+        # by the graftcheck registry-drift pass), so a chaos run's trace
+        # shows exactly where the injected failure entered the request
+        span_event("fault_injected", point=point, rule=key,
+                   action=action)
         if action == "delay":
             time.sleep(delay_s)
         elif action == "callable" and fn is not None:

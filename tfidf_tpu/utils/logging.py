@@ -4,6 +4,14 @@ The reference logs narratively on every path via SLF4J/Logback
 (``logback.xml:27-29``; e.g. ``Leader.java:41-90``, ``Worker.java:59-89``).
 Here we emit single-line structured records (human prefix + key=value tail)
 so the same stream doubles as a machine-parseable event log.
+
+Records emitted while a trace span is active (``utils/tracing.py``)
+carry a ``trace=<trace id>`` field, so slow-query log lines and every
+warn/error on a traced request path are joinable with ``GET
+/api/trace/<id>`` output. The trace id is read off a contextvar at
+RECORD CREATION time (``_KVAdapter.process`` runs on the emitting
+thread), not at formatting time — handlers may format on another
+thread where the contextvar would be empty.
 """
 
 from __future__ import annotations
@@ -16,6 +24,13 @@ import time
 
 _CONFIGURED = False
 _LOCK = threading.Lock()
+
+
+def _trace_id() -> str | None:
+    # late import: logging is imported by nearly everything, including
+    # modules tracing itself depends on at import time
+    from tfidf_tpu.utils.tracing import current_trace_id
+    return current_trace_id()
 
 
 class _KVFormatter(logging.Formatter):
@@ -35,6 +50,9 @@ class _KVAdapter(logging.LoggerAdapter):
 
     def process(self, msg, kwargs):
         kv = {k: v for k, v in kwargs.items() if k not in self._RESERVED}
+        tid = _trace_id()
+        if tid is not None and "trace" not in kv:
+            kv["trace"] = tid
         passthrough = {k: v for k, v in kwargs.items() if k in self._RESERVED}
         passthrough.setdefault("extra", {})["kv"] = kv
         return msg, passthrough

@@ -16,7 +16,13 @@ Three sub-checks (generalizing PR 1's one-off anti-stale test):
    (``global_metrics.get("…")``, the CLI's snapshot lookups) must be
    EMITTED somewhere (``inc``/``observe``/``set_gauge``; f-string
    emissions match by pattern; ``observe`` names also cover their
-   snapshot-derived ``_count``/``_mean_ms``/… suffixes).
+   snapshot-derived ``_count``/``_mean_ms``/``_p50_ms``/… suffixes).
+4. **fault-trace coupling** — ``FaultInjector.check`` must emit a
+   trace span event on every FIRE (``span_event("fault_injected", …)``
+   in utils/faults.py): because every ``fault_point()``/``check()``
+   site routes through that one method, fault injection is visible in
+   traces by construction — and this check fails if the emission is
+   ever refactored away.
 
 Everything is read via AST — ``KNOWN_FAULT_POINTS`` and the Config
 fields are parsed out of their literals, never imported.
@@ -30,7 +36,8 @@ import re
 
 from tools.graftcheck.core import Finding, SourceTree, _dotted
 
-_TIMING_SUFFIXES = ("_count", "_mean_ms", "_min_ms", "_max_ms", "_sum_ms")
+_TIMING_SUFFIXES = ("_count", "_mean_ms", "_min_ms", "_max_ms", "_sum_ms",
+                    "_p50_ms", "_p95_ms", "_p99_ms")
 
 
 # ---------------------------------------------------------------------------
@@ -292,6 +299,44 @@ def check_metrics(tree: SourceTree) -> list[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# 4. fault-point -> trace-event coupling
+# ---------------------------------------------------------------------------
+
+def check_fault_trace(tree: SourceTree) -> list[Finding]:
+    """Every fault FIRE must land a span event. All fault_point()/
+    check() call sites route through ``FaultInjector.check`` (the
+    fault-points sub-check above keeps that registry honest), so one
+    structural guarantee suffices: the check method's fire path must
+    call ``span_event("fault_injected", …)``. A chaos run's trace then
+    shows exactly where each injected failure entered the request —
+    by construction, for every present and future fault point."""
+    mi = tree.modules["utils.faults"]
+    fn = next(
+        (n for cls in ast.walk(mi.tree)
+         if isinstance(cls, ast.ClassDef) and cls.name == "FaultInjector"
+         for n in cls.body
+         if isinstance(n, ast.FunctionDef) and n.name == "check"), None)
+    if fn is None:
+        return [Finding(
+            "registry_drift", "registry_drift:faults:no-check-method",
+            "FaultInjector.check not found — the fault-trace pass went "
+            "stale", "tfidf_tpu/utils/faults.py", 1)]
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call) and node.args
+                and (_dotted(node.func) or "").split(".")[-1]
+                == "span_event"
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "fault_injected"):
+            return []
+    return [Finding(
+        "registry_drift", "registry_drift:faults:fire-not-traced",
+        "FaultInjector.check no longer emits the 'fault_injected' span "
+        "event on fire — fault injection must stay visible in traces "
+        "by construction (every fault_point() site routes through "
+        "this method)", mi.relpath, fn.lineno)]
+
+
 def analyze(tree: SourceTree, root: str) -> list[Finding]:
     return (check_fault_points(tree) + check_config(tree, root)
-            + check_metrics(tree))
+            + check_metrics(tree) + check_fault_trace(tree))

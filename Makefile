@@ -33,6 +33,14 @@
 #                     ADMITTED interactive queries stays bounded, every
 #                     admitted result in exact single-node-oracle
 #                     parity (tests/test_admission.py -m slow)
+#   make chaos-autopilot  slow SLO-autopilot chaos job: step-change
+#                     (1x -> 2x) zipfian closed loop with the
+#                     autopilot enabled at fast cadence and a mid-run
+#                     worker kill -9 — the control loop must make real
+#                     adjustments, converge WITHOUT oscillation (no
+#                     sign-flapping adjustments), keep admitted p99
+#                     bounded, and revert exactly to static config on
+#                     the kill switch (tests/test_autopilot.py -m slow)
 #   make chaos-partition  slow jepsen-style partition chaos job: a
 #                     concurrent upsert/delete/search workload while
 #                     the network nemesis (cluster/nemesis.py) deposes
@@ -75,8 +83,8 @@
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
-        chaos-overload chaos-partition faults bench bench-overload \
-        probe-overlap graftcheck lockdep check trace-demo
+        chaos-overload chaos-partition chaos-autopilot faults bench \
+        bench-overload probe-overlap graftcheck lockdep check trace-demo
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -96,7 +104,7 @@ lockdep:
 	  tests/test_resilience.py tests/test_cluster.py \
 	  tests/test_replication.py tests/test_rebalance.py \
 	  tests/test_admission.py tests/test_partition.py \
-	  tests/test_observability.py \
+	  tests/test_observability.py tests/test_autopilot.py \
 	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
@@ -122,6 +130,9 @@ chaos-overload:
 
 chaos-partition:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_partition.py $(PYTEST_FLAGS) -m slow
+
+chaos-autopilot:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_autopilot.py $(PYTEST_FLAGS) -m slow
 
 faults:
 	python -m tfidf_tpu faults list

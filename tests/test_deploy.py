@@ -60,6 +60,35 @@ def test_k8s_manifest_structure():
     assert vols == {"documents", "index"}
 
 
+def test_k8s_autopilot_enabled_with_clamps():
+    """The manifest ships the SLO autopilot, not hand-tuned constants:
+    the guessed TFIDF_SCATTER_HEDGE_MS=250 is gone (the hedge delay is
+    derived from the observed scatter p95 on whatever hardware the
+    pods land on), replaced by autopilot enablement plus a
+    conservative clamp envelope and the operator-owned p99 SLO."""
+    with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    node = next(d for d in docs if d["kind"] == "Deployment")
+    pod = node["spec"]["template"]["spec"]
+    env = {e["name"]: e.get("value")
+           for e in pod["containers"][0]["env"]}
+    # the hand-tuned constant must NOT come back
+    assert "TFIDF_SCATTER_HEDGE_MS" not in env
+    assert env["TFIDF_AUTOPILOT_ENABLED"] == "true"
+    # conservative clamp envelope: floor < ceiling, both positive
+    floor = float(env["TFIDF_AUTOPILOT_HEDGE_FLOOR_MS"])
+    ceil = float(env["TFIDF_AUTOPILOT_HEDGE_CEILING_MS"])
+    assert 0 < floor < ceil
+    assert float(env["TFIDF_AUTOPILOT_P99_SLO_MS"]) > 0
+    # every autopilot env var is a real Config field (the generic
+    # env-override loop must be able to load each one)
+    from tfidf_tpu.utils.config import Config
+    fields = {f.upper() for f in Config.__dataclass_fields__}
+    for name in env:
+        if name.startswith("TFIDF_AUTOPILOT"):
+            assert name[len("TFIDF_"):] in fields, name
+
+
 def test_k8s_coordinator_ensemble():
     """The coordination substrate deploys as a 3-member quorum ensemble:
     StatefulSet + headless peer service + PVC-backed --data-dir (the

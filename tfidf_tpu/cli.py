@@ -14,6 +14,7 @@ The reference ships one Spring Boot fat jar that every node runs
     status       client: node role + live membership + degraded summary
     drain        client: migrate a worker empty before decommission
     trace        client: fetch + render a distributed request trace
+    autopilot    client: SLO-autopilot state, decision audit, kill switch
     bench        run the TPU benchmark
     faults       chaos tooling: list registered fault points
 
@@ -439,6 +440,25 @@ def cmd_status(args) -> int:
     # front door shedding, why, and is the result cache earning its keep
     hits = metrics.get("cache_hits", 0)
     misses = metrics.get("cache_misses", 0)
+    # SLO-autopilot summary (README "SLO autopilot"): is the closed
+    # loop steering, where each managed knob sits vs its static config
+    # value, and how fresh the last decision is. Best-effort: a
+    # pre-autopilot node simply has no block.
+    try:
+        ap = json.loads(http_get(url + "/api/autopilot?recent=0"))
+        snap = ap.get("autopilot", {})
+        out["autopilot"] = {
+            "enabled": bool(snap.get("enabled")),
+            "knobs": {
+                k: {"current": v.get("current"),
+                    "static": v.get("static"),
+                    "adjustments": v.get("adjustments", 0)}
+                for k, v in snap.get("knobs", {}).items()},
+            "decisions_recorded": snap.get("decisions_recorded", 0),
+            "last_decision_age_s": snap.get("last_decision_age_s"),
+        }
+    except Exception:
+        pass
     out["admission"] = {
         "admitted_total": int(metrics.get("admission_admitted", 0)),
         "shed_total": int(metrics.get("admission_shed_total", 0)),
@@ -599,6 +619,66 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_autopilot(args) -> int:
+    """Inspect (and toggle) the SLO autopilot: ``GET /api/autopilot``
+    rendered as a knob table plus the newest decision-audit records —
+    which sensor inputs were read, what was decided, what was written.
+    ``--enable`` / ``--disable`` flip the runtime kill switch
+    (disabling reverts every managed knob to static config before the
+    command returns). The loop runs on the LEADER, so the request is
+    routed there via ``/api/leader`` when ``--leader`` actually points
+    at a worker."""
+    from tfidf_tpu.cluster.node import http_get, http_post
+
+    url = _leader_url(args)
+    try:
+        addr = json.loads(http_get(url + "/api/leader")).get("leader")
+        if addr:
+            url = str(addr).rstrip("/")
+    except Exception:
+        pass   # pre-/api/leader node: talk to the given URL
+    if args.enable or args.disable:
+        body = json.dumps({"enabled": bool(args.enable)}).encode()
+        resp = json.loads(http_post(url + "/api/autopilot", body))
+        snap = resp["autopilot"]
+    else:
+        resp = json.loads(http_get(
+            url + f"/api/autopilot?recent={int(args.recent)}"))
+        snap = resp["autopilot"]
+    if args.json:
+        print(json.dumps(resp, indent=2))
+        return 0
+    state = "ENABLED" if snap.get("enabled") else "disabled"
+    print(f"autopilot {state} (node {url})")
+    print(f"  interval {snap.get('interval_ms')}ms, "
+          f"hysteresis {snap.get('hysteresis')}, "
+          f"step {snap.get('step')}, confirm {snap.get('confirm')}, "
+          f"p99 SLO {snap.get('p99_slo_ms')}ms")
+    knobs = snap.get("knobs", {})
+    if knobs:
+        w = max(len(k) for k in knobs)
+        print(f"  {'knob'.ljust(w)}  current   static    "
+              f"[floor..ceiling]  dir  adjusts  last")
+        for k, v in knobs.items():
+            age = v.get("last_adjust_age_s")
+            print(f"  {k.ljust(w)}  {v['current']:>8}  "
+                  f"{v['static']:>8}  [{v['floor']:g}.."
+                  f"{v['ceiling']:g}]  {v['last_direction']:>+2d}  "
+                  f"{v['adjustments']:>7}  "
+                  f"{(str(age) + 's ago') if age is not None else '-'}")
+    decs = resp.get("decisions", [])
+    if decs:
+        print(f"  last {len(decs)} decision(s):")
+        for d in decs:
+            tail = (f" {d['current']} -> {d['new']}"
+                    if d.get("applied") else f" (target {d['target']})")
+            inp = ", ".join(f"{k}={v}"
+                            for k, v in (d.get("inputs") or {}).items())
+            print(f"    #{d['seq']} {d['knob']}: {d['reason']}{tail}"
+                  + (f"  [{inp}]" if inp else ""))
+    return 0
+
+
 def cmd_faults(args) -> int:
     """``faults list``: print every fault point compiled into the tree
     (name + firing site) so chaos configs can be checked against the
@@ -728,6 +808,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write Chrome-trace/Perfetto JSON here instead "
                         "of the text timeline")
     s.set_defaults(fn=cmd_trace)
+
+    s = sub.add_parser("autopilot",
+                       help="inspect / toggle the SLO autopilot")
+    s.add_argument("--leader", required=True, help="any node's base URL "
+                                                   "(routed to the leader)")
+    s.add_argument("--recent", type=int, default=10,
+                   help="decision-audit records to show")
+    toggle = s.add_mutually_exclusive_group()
+    toggle.add_argument("--enable", action="store_true",
+                        help="turn the control loop on")
+    toggle.add_argument("--disable", action="store_true",
+                        help="kill switch: off + revert every knob to "
+                             "static config")
+    s.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the rendered table")
+    s.set_defaults(fn=cmd_autopilot)
 
     s = sub.add_parser("bench", help="run the TPU benchmark")
     s.set_defaults(fn=cmd_bench)

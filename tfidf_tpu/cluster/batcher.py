@@ -156,6 +156,22 @@ class Coalescer:
             raise w.error
         return w.result
 
+    def linger_bounds(self) -> tuple[float, float]:
+        """Current adaptive-linger bounds ``(lo_s, hi_s)``."""
+        return self._linger_lo, self._linger_hi
+
+    def set_linger_bounds(self, lo_s: float | None = None,
+                          hi_s: float | None = None) -> None:
+        """Retune the adaptive-linger bounds live (the SLO autopilot's
+        linger knob). Plain GIL-atomic float writes, matching the
+        unlocked reads in ``_effective_linger_s`` — a dispatcher that
+        reads one old and one new bound computes one slightly-off
+        linger, which is harmless for a latency knob."""
+        if lo_s is not None:
+            self._linger_lo = lo_s
+        if hi_s is not None:
+            self._linger_hi = hi_s
+
     def backlog(self) -> int:
         """LIVE queued items beyond one batch's worth — the admission
         layer's stall-proof overload signal. The ``last_*_queue_depth``

@@ -50,6 +50,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tfidf_tpu.cluster.admission import (LANE_BULK, LANE_INTERACTIVE,
                                          AdmissionController, ResultCache)
+from tfidf_tpu.cluster.autopilot import Autopilot
 from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
 from tfidf_tpu.cluster.coordination import NoNodeError
 from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
@@ -418,6 +419,18 @@ class SearchNode:
         # retry policy + per-worker circuit breakers shared by every
         # leader->worker RPC path (cluster/resilience.py)
         self.resilience = ClusterResilience(self.config)
+        # LIVE hedge delay: reads on the scatter path go through this
+        # attribute (not the frozen config) so the SLO autopilot can
+        # track it to the observed scatter p95; initialized to — and
+        # reverted to, on the kill switch — the static config value
+        self.hedge_ms = float(self.config.scatter_hedge_ms)
+        # closed-loop SLO autopilot (cluster/autopilot.py): leader-side
+        # controller riding the sweep loop below that tunes hedge_ms,
+        # the admission watermarks, the adaptive-linger ceiling, and
+        # the gray-failure slow-trip threshold from the live
+        # histograms — each with hysteresis, clamps, damping, a
+        # decision-audit ring (GET /api/autopilot), and a kill switch
+        self.autopilot = Autopilot(self)
         # leadership fencing (cluster/fencing.py): the worker-side
         # guard (highest leader epoch ever seen, durable beside the
         # index so a reboot mid-partition cannot be captured by a
@@ -1329,8 +1342,12 @@ class SearchNode:
         # hedged duplicate reads (The Tail at Scale): per laggard, the
         # ownership slice goes to the next replica while the primary is
         # still in flight; the merge below dedups by owner epoch
+        # the hedge delay is the LIVE knob (autopilot-tunable; equals
+        # config.scatter_hedge_ms unless the autopilot moved it),
+        # read once so the guard and the wait agree within a request
+        hedge_ms = self.hedge_ms
         hedge_futs: dict[str, list[tuple[str, list[str], object]]] = {}
-        if self.config.scatter_hedge_ms > 0 and view.owned:
+        if hedge_ms > 0 and view.owned:
             def dispatch_hedge(addr: str) -> None:
                 names = view.owned.get(addr)
                 if not names:
@@ -1346,8 +1363,7 @@ class SearchNode:
                         (backup, ns, self._slice_pool.submit(
                             self._slice_call, backup, queries, ns,
                             t_deadline, live, tparent, "hedge")))
-            hedge_laggards(dict(futures),
-                           self.config.scatter_hedge_ms / 1e3,
+            hedge_laggards(dict(futures), hedge_ms / 1e3,
                            dispatch_hedge)
 
         ok: dict[str, list] = {}
@@ -1728,6 +1744,9 @@ class SearchNode:
                 # elastic rebalance rides the same leader-side loop,
                 # self-paced by rebalance_sweep_ms
                 self.rebalancer.maybe_run()
+                # SLO autopilot control pass (cluster/autopilot.py),
+                # self-paced by autopilot_interval_ms
+                self.autopilot.maybe_run()
                 # residue anti-entropy (ghost/orphan reconciliation),
                 # self-paced by residue_sweep_ms
                 now = time.monotonic()
@@ -2917,6 +2936,18 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._text("missing worker", 400)
                     return
                 self._json(node.rebalancer.drain_status(worker))
+            elif u.path == "/api/autopilot":
+                # autopilot state + decision-audit ring (observability
+                # lane, never admission-controlled — an operator must
+                # be able to audit the controller exactly while the
+                # cluster it steers is shedding). ?recent=N bounds the
+                # decision records returned (default 50).
+                try:
+                    n = int(self._query_param(u, "recent") or 50)
+                except ValueError:
+                    n = 50
+                self._json({"autopilot": node.autopilot.snapshot(),
+                            "decisions": node.autopilot.decisions(n)})
             elif u.path in ("/api/metrics", "/metrics"):
                 # /metrics is the conventional Prometheus scrape path
                 # (deploy/k8s.yaml annotates it); /api/metrics keeps
@@ -3148,6 +3179,22 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._json(node.rebalancer.cancel_drain(worker))
                 else:
                     self._json(node.rebalancer.start_drain(worker))
+            elif u.path == "/api/autopilot":
+                # the runtime kill switch. Body: {"enabled": bool}.
+                # Disabling reverts every managed knob to its static
+                # config value BEFORE the reply is sent — the caller
+                # observes a cluster already back on hand-tuned
+                # constants. Acts on THIS node's autopilot (the loop
+                # does work only while leader, so point it at the
+                # leader); not admission-controlled — the switch must
+                # work exactly when the front door sheds.
+                req = json.loads(self._body().decode("utf-8"))
+                if not isinstance(req, dict) or not isinstance(
+                        req.get("enabled"), bool):
+                    self._text("body must be {\"enabled\": bool}", 400)
+                    return
+                self._json({"autopilot":
+                            node.autopilot.set_enabled(req["enabled"])})
             elif u.path == "/admin/checkpoint":
                 # on-demand durability point (reference analog: the
                 # per-upload indexWriter.commit(), Worker.java:138)

@@ -539,6 +539,14 @@ class ClusterResilience:
                     if key not in live:
                         del self._lat[key]
 
+    def latency_snapshot(self) -> dict[str, tuple[float, int]]:
+        """Copy of the per-worker successful-call latency EWMAs:
+        ``{worker: (ewma_seconds, samples)}``. The SLO autopilot's
+        slow-trip controller derives ``breaker_slow_threshold_ms``
+        from the cross-worker spread of these."""
+        with self._lat_lock:
+            return dict(self._lat)
+
     def _note_latency(self, worker: str, dt_s: float) -> None:
         if self.slow_threshold_s <= 0:
             return

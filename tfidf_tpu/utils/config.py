@@ -350,6 +350,60 @@ class Config:
     # bucket) stops being retried once the bucket's budget is spent.
     compile_retry_per_bucket: int = 2
 
+    # --- SLO autopilot (cluster/autopilot.py) ---
+    # Master kill switch for the leader-side closed-loop controller
+    # that tunes the serving knobs (scatter hedge delay, admission
+    # watermarks, adaptive-linger ceiling, gray-failure slow-trip
+    # threshold) from the live PR-9 histograms. Off = every knob keeps
+    # its static config value, exactly as before; flipping it off at
+    # runtime (POST /api/autopilot) reverts every managed knob to
+    # static INSTANTLY.
+    autopilot_enabled: bool = False
+    # Control-sweep self-pacing inside the reconcile sweep loop (the
+    # sweep interval is the floor). Negative disables the automatic
+    # pass; run_once() still works on demand.
+    autopilot_interval_ms: float = 2000.0
+    # Relative hysteresis dead band: a knob moves only when the sensed
+    # target differs from the current value by more than this fraction
+    # — the noise filter that makes oscillation structurally hard.
+    autopilot_hysteresis: float = 0.15
+    # Damping: fraction of the (target - current) error applied per
+    # adjustment. 1.0 would jump straight to the target (and ring on
+    # noisy sensors); 0.5 converges geometrically.
+    autopilot_step: float = 0.5
+    # Direction confirmation: a knob moves only after this many
+    # CONSECUTIVE sweeps proposed the same direction, so a one-window
+    # sensor blip can never reverse an adjustment trend.
+    autopilot_confirm: int = 2
+    # Bound on the decision-audit ring (GET /api/autopilot).
+    autopilot_ring: int = 256
+    # Minimum observations a sensor window needs before its controller
+    # may act (a 3-sample p95 is noise, not a signal).
+    autopilot_min_window: int = 16
+    # The one number the operator owns: the admitted-interactive p99
+    # target the watermark controller steers toward. Everything else
+    # is derived.
+    autopilot_p99_slo_ms: float = 600.0
+    # Hedge controller: scatter_hedge_ms tracks the windowed scatter-
+    # leg p95 plus this epsilon, clamped to [floor, ceiling].
+    autopilot_hedge_epsilon_ms: float = 10.0
+    autopilot_hedge_floor_ms: float = 5.0
+    autopilot_hedge_ceiling_ms: float = 2000.0
+    # Watermark controller clamps (admission_queue_high_water; the
+    # critical mark keeps the static critical/high ratio).
+    autopilot_queue_floor: int = 4
+    autopilot_queue_ceiling: int = 8192
+    # Linger controller clamps on the adaptive scatter linger CEILING
+    # (scatter_linger_max_ms; the floor bound stays static).
+    autopilot_linger_floor_ms: float = 1.0
+    autopilot_linger_ceiling_ms: float = 50.0
+    # Slow-trip controller: breaker_slow_threshold_ms is derived from
+    # the cross-worker successful-call latency-EWMA spread (median x
+    # this multiple), clamped below.
+    autopilot_slow_spread_mult: float = 4.0
+    autopilot_slow_floor_ms: float = 50.0
+    autopilot_slow_ceiling_ms: float = 5000.0
+
     # --- observability (utils/tracing.py, utils/metrics.py) ---
     # Bound on the in-process span ring buffer (finished spans kept for
     # GET /api/trace). Appends are GIL-atomic deque ops — the bound is

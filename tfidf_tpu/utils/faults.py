@@ -54,6 +54,9 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
     "leader.admission": "front-door admission decision for one "
                         "/leader/* request (arm to chaos-test the "
                         "shed path itself)",
+    "leader.autopilot": "one SLO-autopilot control pass on the leader "
+                        "(arm to chaos-test the sweep loop's tolerance "
+                        "of a failing controller)",
     "worker.process": "worker handling /worker/process[-batch]",
     "worker.upload": "worker handling /worker/upload[-batch]",
     "worker.fence": "worker checking a mutating RPC's X-Leader-Epoch "

@@ -46,6 +46,41 @@ BUCKET_BOUNDS_S: tuple[float, ...] = tuple(
     for i in range(_N_BUCKETS))
 
 
+def bucket_quantile(counts: list[int], n: int, q: float,
+                    mn: float | None = None,
+                    mx: float | None = None) -> float | None:
+    """Quantile estimate in SECONDS from raw histogram bucket counts
+    (``len == len(BUCKET_BOUNDS_S) + 1``; last is +Inf): geometric
+    interpolation inside the covering bucket. The ONE implementation
+    shared by the cumulative-histogram quantiles below and the SLO
+    autopilot's windowed deltas (cluster/autopilot.py) — a change to
+    the bucket geometry or the interpolation cannot diverge between
+    them. ``mn``/``mx`` clamp to observed extremes when the caller has
+    them (the cumulative path); a window delta has none, so the +Inf
+    bucket falls back to the last finite bound."""
+    if n <= 0:
+        return None
+    target = min(max(1, math.ceil(q * n)), n)
+    cum = 0
+    idx = len(counts) - 1
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            idx = i
+            cum -= c   # cumulative BEFORE this bucket
+            break
+    if idx >= len(BUCKET_BOUNDS_S):       # +Inf bucket
+        return mx if mx is not None else BUCKET_BOUNDS_S[-1]
+    hi = BUCKET_BOUNDS_S[idx]
+    lo = (BUCKET_BOUNDS_S[idx - 1] if idx > 0
+          else hi / _BUCKET_RATIO)
+    frac = (target - cum) / counts[idx]
+    est = lo * (hi / lo) ** frac
+    if mn is not None and mx is not None:
+        est = min(max(est, mn), mx)
+    return est
+
+
 class MetricKindError(ValueError):
     """A metric name was emitted as both a counter and a gauge — the
     silent-shadowing bug class this guard exists to fail loudly."""
@@ -110,24 +145,18 @@ class Metrics:
             return mn
         if q >= 1.0:
             return mx
-        counts = self._hist[name]
-        target = min(max(1, math.ceil(q * n)), n)
-        cum = 0
-        idx = len(counts) - 1
-        for i, c in enumerate(counts):
-            cum += c
-            if cum >= target:
-                idx = i
-                cum -= c   # cumulative BEFORE this bucket
-                break
-        if idx >= len(BUCKET_BOUNDS_S):       # +Inf bucket
-            return mx
-        hi = BUCKET_BOUNDS_S[idx]
-        lo = (BUCKET_BOUNDS_S[idx - 1] if idx > 0
-              else hi / _BUCKET_RATIO)
-        frac = (target - cum) / counts[idx]
-        est = lo * (hi / lo) ** frac
-        return min(max(est, mn), mx)
+        return bucket_quantile(self._hist[name], n, q, mn=mn, mx=mx)
+
+    def hist_snapshot(self, name: str) -> tuple[list[int], int] | None:
+        """Copy of one histogram's raw bucket counts plus its total
+        observation count, or None when nothing was observed. The SLO
+        autopilot (cluster/autopilot.py) diffs two snapshots to get a
+        WINDOWED distribution — the cumulative histogram alone would
+        let hours-old samples outvote the last control interval."""
+        with self._lock:
+            if name not in self._timings or not self._timings[name][0]:
+                return None
+            return list(self._hist[name]), self._timings[name][0]
 
     def quantile(self, name: str, q: float) -> float | None:
         """Live latency quantile in seconds (e.g. ``quantile("scatter_rpc",

@@ -79,6 +79,14 @@ def _epoch_anchor() -> float:
 
 _EPOCH0 = _epoch_anchor()
 
+
+def epoch_now() -> float:
+    """Epoch seconds derived from the reviewed wall-clock anchor plus
+    the monotonic clock — the timestamp helper for records that must
+    be human-meaningful (span starts, autopilot decisions) without
+    adding new raw ``time.time()`` reads (graftcheck wallclock pass)."""
+    return _EPOCH0 + time.monotonic()
+
 # per-process id entropy: span ids must not collide across the nodes of
 # an in-process test cluster, so the generator is seeded from urandom.
 # No lock: getrandbits/random are single C-level calls, GIL-atomic in

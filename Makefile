@@ -41,6 +41,13 @@
 #                     sign-flapping adjustments), keep admitted p99
 #                     bounded, and revert exactly to static config on
 #                     the kill switch (tests/test_autopilot.py -m slow)
+#   make chaos-router  slow query-plane chaos job: 2x zipfian load
+#                     through two stateless routers while a router AND
+#                     the leader are killed -9 mid-workload — the
+#                     surviving router keeps serving, every admitted
+#                     read is exact single-node-oracle parity or
+#                     honestly degraded (X-Scatter-Degraded), and the
+#                     tier heals (tests/test_router.py -m slow)
 #   make chaos-partition  slow jepsen-style partition chaos job: a
 #                     concurrent upsert/delete/search workload while
 #                     the network nemesis (cluster/nemesis.py) deposes
@@ -62,6 +69,11 @@
 #   make bench-overload  zipfian closed-loop overload bench (1x and 2x
 #                     saturating concurrency, per-lane p50/p99 latency,
 #                     shed rate, cache hit rate); writes OVERLOAD.json
+#   make bench-routers  multi-router scale-out bench: the same zipfian
+#                     closed loop at equal offered load through 1, 2,
+#                     and 4 stateless routers; admitted interactive
+#                     q/s must scale (2 routers >= 1.6x the 1-router
+#                     baseline); writes BENCH_r07.json
 
 #   make trace-demo   zero-to-aha for the tracing layer: spin a small
 #                     in-process cluster, kill a worker mid-request,
@@ -83,8 +95,9 @@
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
-        chaos-overload chaos-partition chaos-autopilot faults bench \
-        bench-overload probe-overlap graftcheck lockdep check trace-demo
+        chaos-overload chaos-partition chaos-autopilot chaos-router \
+        faults bench bench-overload bench-routers probe-overlap \
+        graftcheck lockdep check trace-demo
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -105,6 +118,7 @@ lockdep:
 	  tests/test_replication.py tests/test_rebalance.py \
 	  tests/test_admission.py tests/test_partition.py \
 	  tests/test_observability.py tests/test_autopilot.py \
+	  tests/test_router.py \
 	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
@@ -134,6 +148,9 @@ chaos-partition:
 chaos-autopilot:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_autopilot.py $(PYTEST_FLAGS) -m slow
 
+chaos-router:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py $(PYTEST_FLAGS) -m slow
+
 faults:
 	python -m tfidf_tpu faults list
 
@@ -145,3 +162,6 @@ probe-overlap:
 
 bench-overload:
 	BENCH_OUT=OVERLOAD.json python bench.py --overload
+
+bench-routers:
+	BENCH_OUT=BENCH_r07.json python bench.py --routers

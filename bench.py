@@ -1139,6 +1139,325 @@ def overload_main() -> None:
 
 
 # --------------------------------------------------------------------------
+# router scale-out: admitted q/s through 1/2/4 stateless routers
+# (ISSUE 12 tentpole; ROADMAP item 1 — retire the single-leader
+# front-door ceiling)
+# --------------------------------------------------------------------------
+
+RT7_DOCS = 4_000
+RT7_VOCAB = 30_000
+RT7_AVG_LEN = 60
+RT7_QUERY_POOL = 512        # distinct queries; zipf skew over the pool
+RT7_TAIL_EVERY = 33         # every Nth request carries a unique query
+#                             no cache can absorb (a ~3% tail). The
+#                             backend stays FIXED (2 workers) across
+#                             phases by design — this bench scales the
+#                             FRONT DOOR, so the workload is the
+#                             cache-headed interactive regime where
+#                             the front door is the binding tier (the
+#                             worker tier has its own HPA/bench story)
+RT7_CACHE = 2_048           # per-ROUTER result cache (>= pool: the
+#                             zipf head answers router-side)
+RT7_CLIENT_PROCS = 12       # load-generator PROCESSES (one python
+#                             process cannot generate enough closed-
+#                             loop traffic to saturate even two
+#                             routers — the generator must never be
+#                             the measured ceiling)
+RT7_CLIENT_THREADS = 6      # closed-loop connections per process
+RT7_WARM_S = 5.0
+RT7_PHASE_S = 10.0
+RT7_COUNTS = (1, 2, 4)
+
+# the closed-loop client subprocess: threads hammer ONE router over
+# keep-alive connections, honoring 429 Retry-After; only the measure
+# window (after warm_s) is recorded. Run via `python -c` with a JSON
+# spec file — no pickling, no fork-with-threads, no bench import.
+_R7_CLIENT_SRC = r'''
+import http.client, json, socket, sys, threading, time
+spec = json.load(open(sys.argv[1]))
+port, queries = spec["port"], spec["queries"]
+warm_end = time.monotonic() + spec["warm_s"]
+stop_at = warm_end + spec["measure_s"]
+lats, shed, errors = [], [0], []
+lock = threading.Lock()
+
+def run(tid, seq):
+    conn = None
+    i = 0
+    while time.monotonic() < stop_at:
+        q = queries[seq[i % len(seq)]]
+        if spec["tail_every"] and i % spec["tail_every"] == 0:
+            q = f"{q} zztail{port}x{tid}x{i}"
+        i += 1
+        t1 = time.monotonic()
+        try:
+            if conn is None:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.connect()
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+            conn.request("POST", "/leader/start", body=q.encode(),
+                         headers={"Content-Type": "text/plain"})
+            r = conn.getresponse()
+            r.read()
+            st, ra = r.status, r.getheader("Retry-After")
+            if r.will_close:
+                conn.close()
+                conn = None
+        except Exception as e:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = None
+            errors.append(repr(e))
+            return
+        t2 = time.monotonic()
+        if st == 200:
+            if t1 >= warm_end:
+                with lock:
+                    lats.append(t2 - t1)
+        elif st == 429:
+            if t1 >= warm_end:
+                with lock:
+                    shed[0] += 1
+            time.sleep(min(float(ra or 0.05), 0.5))
+        else:
+            errors.append(f"status {st}")
+            return
+
+threads = [threading.Thread(target=run, args=(k, s))
+           for k, s in enumerate(spec["seqs"])]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+print(json.dumps({"lats": lats, "shed": shed[0],
+                  "errors": errors[:3]}))
+'''
+
+
+def bench_routers(rng, corpus: tuple | None = None) -> dict:
+    """Scale-out query plane (cluster/router.py): the same zipfian
+    closed-loop interactive workload at EQUAL offered load
+    (``RT7_CLIENTS`` clients) through 1, 2, and 4 stateless router
+    processes in front of one 2-worker cluster. Each router runs its
+    own admission/coalescer/cache/resilience stack against a
+    watch-refreshed placement follower view; the contract under test
+    is near-linear admitted-q/s scaling with router count (the
+    acceptance bar: 2 routers >= 1.6x the 1-router baseline) with
+    router results parity-checked against the leader's before any
+    phase is measured."""
+    import concurrent.futures
+    import json as _json
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    if corpus is None:
+        t0 = time.perf_counter()
+        texts = make_texts(rng, RT7_DOCS, RT7_VOCAB, RT7_AVG_LEN)
+        queries = make_queries(rng, RT7_VOCAB, RT7_QUERY_POOL)
+        log(f"[r7] corpus in {time.perf_counter()-t0:.0f}s")
+    else:
+        texts, queries = corpus
+
+    env = dict(os.environ, TFIDF_JAX_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "TFIDF_ROUTER_CACHE_ENTRIES": str(RT7_CACHE),
+        "TFIDF_ROUTER_REFRESH_MS": "500",
+    })
+    procs = []
+    tmp = tempfile.mkdtemp(prefix="bench_r7_")
+
+    def spawn(args):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tfidf_tpu", *args], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        procs.append(p)
+        return p
+
+    client = _KeepAlive()
+    try:
+        coord = _free_port()
+        spawn(["coordinator", "--listen", f"127.0.0.1:{coord}"])
+        _wait_until(lambda: socket.create_connection(
+            ("127.0.0.1", coord), timeout=1).close() or True)
+        ports = [_free_port() for _ in range(3)]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        for i, port in enumerate(ports):
+            spawn(["serve", "--port", str(port), "--host", "127.0.0.1",
+                   "--coordinator-address", f"127.0.0.1:{coord}",
+                   "--documents-path", f"{tmp}/n{i}/docs",
+                   "--index-path", f"{tmp}/n{i}/index"])
+            _wait_until(lambda u=urls[i]: _http_get(u + "/api/status"))
+        leader = urls[0]
+        leader_hp = ("127.0.0.1", ports[0])
+        _wait_until(lambda: len(_json.loads(
+            _http_get(leader + "/api/services"))) == 2)
+
+        groups = [[{"name": f"d{i}.txt", "text": texts[i]}
+                   for i in range(lo, min(lo + 500, RT7_DOCS))]
+                  for lo in range(0, RT7_DOCS, 500)]
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            list(ex.map(
+                lambda g: client.post(leader_hp, "/leader/upload-batch",
+                                      _json.dumps(g).encode()),
+                groups))
+        log(f"[r7] uploaded {RT7_DOCS} docs in "
+            f"{time.perf_counter()-t0:.0f}s")
+
+        def run_phase(n_routers: int) -> dict:
+            rports = [_free_port() for _ in range(n_routers)]
+            rurls = [f"http://127.0.0.1:{p}" for p in rports]
+            rprocs = []
+            for p in rports:
+                rprocs.append(spawn([
+                    "router", "--coordinator", f"127.0.0.1:{coord}",
+                    "--host", "127.0.0.1", "--port", str(p)]))
+            for u in rurls:
+                _wait_until(lambda u=u: _json.loads(_http_get(
+                    u + "/api/router"))["placement"]["docs"]
+                    == RT7_DOCS)
+            # correctness gate BEFORE measuring: router results must
+            # equal the leader's exactly (same placement world)
+            for q in queries[:8]:
+                via_leader = _json.loads(client.post(
+                    leader_hp, "/leader/start", q.encode()))
+                for i, p in enumerate(rports):
+                    via_router = _json.loads(client.post(
+                        ("127.0.0.1", p), "/leader/start", q.encode()))
+                    if via_router != via_leader:
+                        raise RuntimeError(
+                            f"[r7] router {i} result diverges from "
+                            f"the leader for {q!r}")
+
+            # EQUAL offered load every phase: the same client-process
+            # fleet, distributed round-robin over however many routers
+            # this phase runs
+            cprocs = []
+            spec_files = []
+            for c in range(RT7_CLIENT_PROCS):
+                crng = np.random.default_rng(
+                    SEED + 1000 * n_routers + c)
+                seqs = [
+                    _zipf_indices(crng, RT7_QUERY_POOL, 4096).tolist()
+                    for _ in range(RT7_CLIENT_THREADS)]
+                spec = {"port": rports[c % n_routers],
+                        "queries": queries, "seqs": seqs,
+                        "warm_s": RT7_WARM_S,
+                        "measure_s": RT7_PHASE_S,
+                        "tail_every": RT7_TAIL_EVERY}
+                path = os.path.join(tmp, f"r7c_{n_routers}_{c}.json")
+                with open(path, "w", encoding="utf-8") as f:
+                    _json.dump(spec, f)
+                spec_files.append(path)
+                cprocs.append(subprocess.Popen(
+                    [sys.executable, "-c", _R7_CLIENT_SRC, path],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL))
+            lats: list[float] = []
+            sheds = 0
+            errors: list[str] = []
+            for p in cprocs:
+                out, _ = p.communicate(
+                    timeout=RT7_WARM_S + RT7_PHASE_S + 120)
+                got = _json.loads(out)
+                lats.extend(got["lats"])
+                sheds += got["shed"]
+                errors.extend(got["errors"])
+            if errors:
+                raise RuntimeError(f"[r7] {n_routers}-router phase "
+                                   f"client failures: {errors[:3]}")
+            wall = RT7_PHASE_S   # each client records exactly this
+            #                      window (post-warm); closed loop
+            # per-router cache hit rate (process-global metrics are
+            # per-process, i.e. per-router — exactly what we want)
+            hit_rates = []
+            for u in rurls:
+                snap = _json.loads(_http_get(u + "/api/router"))
+                hit_rates.append(snap["cache"]["hit_rate"])
+            _kill_all(rprocs)
+            for p in rprocs:
+                procs.remove(p)
+            ls = sorted(lats)
+            n = len(ls)
+            out = {
+                "routers": n_routers,
+                "clients": RT7_CLIENT_PROCS * RT7_CLIENT_THREADS,
+                "admitted": n,
+                "shed": sheds,
+                "admitted_qps": round(n / wall, 1),
+                "p50_ms": round(ls[n // 2] * 1e3, 1) if n else 0.0,
+                "p99_ms": round(ls[int(n * 0.99)] * 1e3, 1)
+                if n else 0.0,
+                "cache_hit_rate": round(
+                    sum(hit_rates) / len(hit_rates), 4),
+            }
+            log(f"[r7] {n_routers} router(s): "
+                f"{out['admitted_qps']} admitted q/s, "
+                f"p50 {out['p50_ms']}ms, p99 {out['p99_ms']}ms, "
+                f"cache hit {out['cache_hit_rate']:.1%}, "
+                f"shed {out['shed']}")
+            return out
+
+        table = {str(r): run_phase(r) for r in RT7_COUNTS}
+        q1 = table["1"]["admitted_qps"]
+        return {
+            "routers": table,
+            "scaling_2r_vs_1r": round(
+                table["2"]["admitted_qps"] / q1, 4) if q1 else 0.0,
+            "scaling_4r_vs_1r": round(
+                table["4"]["admitted_qps"] / q1, 4) if q1 else 0.0,
+            "parity_checked": True,
+            "n_docs": RT7_DOCS, "query_pool": RT7_QUERY_POOL,
+            "zipf_s": OV_ZIPF_S,
+            "tail_unique": round(1.0 / RT7_TAIL_EVERY, 3),
+            "cache_entries": RT7_CACHE, "phase_s": RT7_PHASE_S,
+            "workers": 2,
+            "backend": "cpu (single-TPU-client tunnel)",
+        }
+    finally:
+        _kill_all(procs)
+
+
+def routers_main() -> None:
+    """Standalone entry (``python bench.py --routers``; ``make
+    bench-routers`` sets ``BENCH_OUT=BENCH_r07.json``): the
+    multi-router scale-out bench, artifact-first like the full sweep.
+    The headline value is admitted interactive q/s at 2 routers; the
+    acceptance ratio is its scaling factor over the 1-router baseline
+    at EQUAL offered load (the bar: >= 1.6x — ISSUE 12)."""
+    os.environ.setdefault("BENCH_OUT", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r07.json"))
+    rng = np.random.default_rng(SEED)
+    r7 = bench_routers(rng)
+    result = {
+        "metric": "router_scaleout_admitted_qps_2r",
+        "value": r7["routers"]["2"]["admitted_qps"],
+        "unit": "queries/sec",
+        # the acceptance ratio: 2-router admitted q/s over the
+        # 1-router baseline at equal offered load (bar: >= 1.6)
+        "vs_baseline": round(r7["scaling_2r_vs_1r"], 2),
+        "extra": r7,
+    }
+    headline = {
+        "qps_1r": r7["routers"]["1"]["admitted_qps"],
+        "qps_2r": r7["routers"]["2"]["admitted_qps"],
+        "qps_4r": r7["routers"]["4"]["admitted_qps"],
+        "scaling_2r": r7["scaling_2r_vs_1r"],
+        "scaling_4r": r7["scaling_4r_vs_1r"],
+        "p99_2r_ms": r7["routers"]["2"]["p99_ms"],
+        "cache_hit_2r": r7["routers"]["2"]["cache_hit_rate"],
+    }
+    _emit_validated(result, headline)
+
+
+# --------------------------------------------------------------------------
 # realistic-text pipeline at 100k docs (VERDICT r3 #3)
 # --------------------------------------------------------------------------
 
@@ -1779,5 +2098,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--overload" in sys.argv:
         overload_main()
+    elif "--routers" in sys.argv:
+        routers_main()
     else:
         main()

@@ -21,12 +21,14 @@ def test_k8s_manifest_structure():
     with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
     kinds = sorted(d["kind"] for d in docs)
-    assert kinds == ["Deployment", "HorizontalPodAutoscaler",
+    assert kinds == ["Deployment", "Deployment",
+                     "HorizontalPodAutoscaler",
+                     "HorizontalPodAutoscaler",
                      "Namespace", "Service", "Service", "Service",
-                     "StatefulSet"]
+                     "Service", "StatefulSet"]
     deployments = {d["metadata"]["name"]: d for d in docs
                    if d["kind"] == "Deployment"}
-    assert set(deployments) == {"tfidf-node"}
+    assert set(deployments) == {"tfidf-node", "tfidf-router"}
 
     node = deployments["tfidf-node"]["spec"]
     assert node["replicas"] == 3
@@ -134,13 +136,15 @@ def test_k8s_hpa_autoscaling():
     a renamed gauge must fail here, not silently stop scaling."""
     with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
-    hpas = [d for d in docs if d["kind"] == "HorizontalPodAutoscaler"]
-    assert len(hpas) == 1
-    spec = hpas[0]["spec"]
+    hpas = {d["spec"]["scaleTargetRef"]["name"]: d for d in docs
+            if d["kind"] == "HorizontalPodAutoscaler"}
+    assert set(hpas) == {"tfidf-node", "tfidf-router"}
+    spec = hpas["tfidf-node"]["spec"]
     ref = spec["scaleTargetRef"]
     assert ref["kind"] == "Deployment" and ref["name"] == "tfidf-node"
     # the HPA floor matches the Deployment's replica count
-    node = next(d for d in docs if d["kind"] == "Deployment")
+    node = next(d for d in docs if d["kind"] == "Deployment"
+                and d["metadata"]["name"] == "tfidf-node")
     assert spec["minReplicas"] == node["spec"]["replicas"]
     assert spec["maxReplicas"] > spec["minReplicas"]
 
@@ -168,6 +172,68 @@ def test_k8s_hpa_autoscaling():
     # drain workers before pods disappear
     assert spec["behavior"]["scaleDown"][
         "stabilizationWindowSeconds"] >= 300
+
+
+def test_k8s_router_tier():
+    """The scale-out query plane ships as a STATELESS router tier
+    (README "Scale-out query plane"): a Deployment with no volumes
+    (nothing to lose — scale-down just deletes pods), its own Service,
+    and an autoscaling/v2 HPA keyed on the per-router queue-depth
+    gauge the router's scatter coalescer actually emits."""
+    with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    router = next(d for d in docs if d["kind"] == "Deployment"
+                  and d["metadata"]["name"] == "tfidf-router")
+    spec = router["spec"]
+    assert spec["replicas"] >= 2
+    pod = spec["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["args"] == ["router"]
+    # stateless: no volumes, no PVCs — a router holds nothing durable
+    assert "volumes" not in pod
+    assert "volumeMounts" not in c
+    env = {e["name"]: e for e in c["env"]}
+    # same coordination connect string as the nodes
+    assert env["TFIDF_COORDINATOR_ADDRESS"]["value"].count(",") == 2
+    assert env["TFIDF_HOST"]["valueFrom"]["fieldRef"][
+        "fieldPath"] == "status.podIP"
+    # every TFIDF_ env var (except the JAX platform pin, which is a
+    # CLI-level override, not a Config field) must be a real Config
+    # field the generic env loop can load
+    from tfidf_tpu.utils.config import Config
+    fields = {f.upper() for f in Config.__dataclass_fields__}
+    for name in env:
+        if name == "TFIDF_JAX_PLATFORM":
+            continue
+        assert name.startswith("TFIDF_")
+        assert name[len("TFIDF_"):] in fields, name
+    # scraped like the nodes (the HPA's custom metric comes from here)
+    ann = spec["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+
+    # the router Service fronts the tier
+    svc = [d for d in docs if d["kind"] == "Service"
+           and d["metadata"]["name"] == "tfidf-router"]
+    assert svc and svc[0]["spec"]["selector"] == {"app": "tfidf-router"}
+
+    # the router HPA scales on the per-router coalescer gauge — and
+    # that gauge name must map to what the code emits: the coalescer's
+    # f"last_{name}_queue_depth" with the router batcher named
+    # "router_scatter"
+    hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler"
+               and d["spec"]["scaleTargetRef"]["name"] == "tfidf-router")
+    spec = hpa["spec"]
+    assert spec["minReplicas"] == router["spec"]["replicas"]
+    assert spec["maxReplicas"] > spec["minReplicas"]
+    names = {m["pods"]["metric"]["name"] for m in spec["metrics"]
+             if m["type"] == "Pods"}
+    assert names == {"tfidf_last_router_scatter_queue_depth"}
+    with open(os.path.join(ROOT, "tfidf_tpu", "cluster",
+                           "router.py"), encoding="utf-8") as f:
+        src = f.read()
+    assert 'name="router_scatter"' in src
+    assert '_queue_depth' in src
 
 
 def test_dockerfile_structure():

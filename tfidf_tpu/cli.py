@@ -6,6 +6,8 @@ The reference ships one Spring Boot fat jar that every node runs
 
     serve        run a cluster node (worker + leader-candidate), optionally
                  with an embedded coordination service
+    router       run a stateless query-plane router (scale-out reads;
+                 mutations forward to the elected leader)
     coordinator  run only the coordination service (the "zookeeper" pod)
     ingest       build a local index from files/directories
     search       query a local index
@@ -169,6 +171,36 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_router(args) -> int:
+    """Run one stateless query-plane router (cluster/router.py): no
+    engine, no shard, no election — a scatter read plane behind
+    ``/leader/start`` + ``/leader/download`` that follows the durable
+    placement znode and forwards every mutation to the elected leader.
+    Kill it and nothing is lost; run N and the interactive front door
+    scales ~N-fold (README "Scale-out query plane")."""
+    from tfidf_tpu.cluster.coordination import CoordinationClient
+    from tfidf_tpu.cluster.router import QueryRouter
+
+    cfg = _load_cfg(args)
+    if args.coordinator:
+        cfg = cfg.replace(coordinator_address=args.coordinator)
+
+    def factory():
+        return CoordinationClient(
+            cfg.coordinator_address,
+            heartbeat_interval_s=cfg.heartbeat_interval_s)
+
+    router = QueryRouter(cfg, coord_factory=factory).start()
+    print(f"router up at {router.url}; "
+          f"coordinator {cfg.coordinator_address}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    router.stop()
+    return 0
+
+
 def parse_peers(spec: str) -> dict[str, str]:
     """``"c0=host0:2181,c1=host1:2181"`` -> ``{"c0": "host0:2181", ...}``
     (the full ensemble member map, including this member)."""
@@ -294,31 +326,44 @@ def _leader_url(args) -> str:
 
 
 def _shed_aware_post(url: str, data: bytes,
-                     content_type: str = "application/json") -> bytes:
-    """POST to the leader honoring its admission layer: a 429 shed is
-    retried only AFTER its ``Retry-After`` hint has elapsed (the
+                     content_type: str = "application/json",
+                     who: str = "leader",
+                     return_headers: bool = False):
+    """POST to a front door honoring its admission layer: a 429 shed
+    is retried only AFTER its ``Retry-After`` hint has elapsed (the
     default classifier + RetryPolicy floor — see resilience.py), and a
     request still shed after the bounded attempts exits with the shed
     message instead of a traceback. The CLI must model the polite
-    client: hammering a saturated leader from the operator's own
-    tooling would amplify the overload the shed is relieving."""
-    import urllib.error
+    client: hammering a saturated front door from the operator's own
+    tooling would amplify the overload the shed is relieving.
 
-    from tfidf_tpu.cluster.node import http_post
+    One protocol for both the ``--leader`` and ``--via-router`` paths
+    (``who`` names the shedding side in the message);
+    ``return_headers=True`` returns ``(reply headers, body)`` — the
+    router path prints the route stamp / degraded markers from them."""
+    import urllib.error
+    import urllib.request
+
     from tfidf_tpu.cluster.resilience import RetryPolicy, retry_after_of
+
+    def once():
+        req = urllib.request.Request(
+            url, data=data, headers={"Content-Type": content_type})
+        with urllib.request.urlopen(req, timeout=60.0) as r:
+            return dict(r.headers), r.read()
 
     policy = RetryPolicy(max_attempts=3, base_delay_s=0.05, name="cli")
     try:
-        return policy.call(
-            lambda: http_post(url, data, content_type=content_type))
+        hdrs, body = policy.call(once)
     except urllib.error.HTTPError as e:
         ra = retry_after_of(e)
         if ra is None:
             raise
-        print(f"leader is shedding load (429, reason="
+        print(f"{who} is shedding load (429, reason="
               f"{e.headers.get('X-Shed-Reason', '?')}): retry after "
               f"{ra:.3f}s", file=sys.stderr)
         raise SystemExit(75)   # EX_TEMPFAIL: try again later
+    return (hdrs, body) if return_headers else body
 
 
 def cmd_upload(args) -> int:
@@ -381,7 +426,28 @@ def cmd_upload(args) -> int:
 
 
 def cmd_query(args) -> int:
+    via = getattr(args, "via_router", None)
+    if not via and not args.leader:
+        print("query needs --leader URL or --via-router URL",
+              file=sys.stderr)
+        return 2
     body = json.dumps({"query": " ".join(args.query)}).encode()
+    if via:
+        # router path: surface the read plane's honesty headers —
+        # which placement world routed the request, and whether the
+        # results are degraded/stale (README "Scale-out query plane").
+        # Same polite-shed protocol as the --leader path: routers run
+        # their own admission controller, so a 429 here is expected.
+        hdrs, out = _shed_aware_post(
+            via.rstrip("/") + "/leader/start", body, who="router",
+            return_headers=True)
+        for h in ("X-Route-Epoch", "X-Route-Generation",
+                  "X-Scatter-Degraded"):
+            v = hdrs.get(h)
+            if v:
+                print(f"{h}: {v}", file=sys.stderr)
+        print(out.decode())
+        return 0
     resp = _shed_aware_post(_leader_url(args) + "/leader/start", body)
     print(resp.decode())
     return 0
@@ -459,6 +525,58 @@ def cmd_status(args) -> int:
         }
     except Exception:
         pass
+    # scale-out query plane summary (README "Scale-out query plane"):
+    # the registered stateless routers, each one's placement
+    # (epoch, generation) lag behind the leader's authoritative map,
+    # staleness, and per-router cache hit rate. Best-effort: a
+    # pre-router node simply has no block; an unreachable router is
+    # listed as such rather than hiding the tier.
+    try:
+        router_urls = json.loads(http_get(url + "/api/routers"))
+    except Exception:
+        router_urls = []
+    if router_urls:
+        ref = {}
+        try:
+            leader_addr = (json.loads(http_get(url + "/api/leader"))
+                           .get("leader")) or url
+            ref = json.loads(http_get(
+                str(leader_addr).rstrip("/") + "/api/router",
+                timeout=3.0)).get("placement", {})
+        except Exception:
+            pass
+        entries = []
+        for r in router_urls:
+            try:
+                snap = json.loads(http_get(
+                    str(r).rstrip("/") + "/api/router", timeout=3.0))
+            except Exception:
+                entries.append({"url": r, "reachable": False})
+                continue
+            pl = snap.get("placement", {})
+            entry = {
+                "url": r, "reachable": True,
+                "placement_epoch": pl.get("epoch"),
+                "placement_gen": pl.get("gen"),
+                "view_age_s": pl.get("age_s"),
+                "stale": bool(pl.get("stale")),
+                "cache_hit_rate":
+                    snap.get("cache", {}).get("hit_rate", 0.0),
+                "writes_proxied": snap.get("writes_proxied", 0),
+            }
+            # lag vs the leader's authoritative map, in generations
+            # and leadership epochs (None when either side is unknown)
+            if (ref.get("gen") is not None
+                    and pl.get("gen") is not None):
+                entry["gen_lag"] = max(
+                    0, int(ref["gen"]) - int(pl["gen"]))
+            if (ref.get("epoch") is not None
+                    and pl.get("epoch") is not None):
+                entry["epoch_lag"] = max(
+                    0, int(ref["epoch"]) - int(pl["epoch"]))
+            entries.append(entry)
+        out["routers"] = {"count": len(router_urls),
+                          "routers": entries}
     out["admission"] = {
         "admitted_total": int(metrics.get("admission_admitted", 0)),
         "shed_total": int(metrics.get("admission_shed_total", 0)),
@@ -751,6 +869,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(majority quorum commits every write)")
     s.set_defaults(fn=cmd_coordinator)
 
+    s = sub.add_parser("router",
+                       help="run a stateless query-plane router")
+    s.add_argument("--coordinator",
+                   help="coordination connect string "
+                        "(host:port[,host:port...]); defaults to "
+                        "TFIDF_COORDINATOR_ADDRESS")
+    s.add_argument("--host")
+    s.add_argument("--port", type=int)
+    s.set_defaults(fn=cmd_router)
+
     s = sub.add_parser("ingest", help="index files/dirs locally")
     s.add_argument("paths", nargs="+")
     s.add_argument("--documents-path")
@@ -778,7 +906,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("query", help="search a running cluster")
     s.add_argument("query", nargs="+")
-    s.add_argument("--leader", required=True)
+    s.add_argument("--leader", help="leader base URL")
+    s.add_argument("--via-router", metavar="URL",
+                   help="route the read through a stateless router "
+                        "(prints the X-Route-Epoch/Generation stamp "
+                        "and any degraded marker to stderr)")
     s.set_defaults(fn=cmd_query)
 
     s = sub.add_parser("status", help="node role + membership + metrics")

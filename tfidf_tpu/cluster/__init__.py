@@ -24,6 +24,11 @@ The TPU-native replacement for the reference's coordination stack
   one live replica scores each document), and durable persistence of
   the map through the coordination substrate so leader failover keeps
   exact ownership.
+- :mod:`router` — the scale-out query plane: the scatter read plane
+  (owner-merge / failover / hedge spine) extracted from the node so it
+  runs against a follower view of the placement znode, and the
+  stateless :class:`~tfidf_tpu.cluster.router.QueryRouter` tier built
+  on it (any-node reads; all mutations stay on the elected leader).
 - :mod:`wal` — L0 durability: CRC-framed write-ahead log, atomic
   snapshots of the znode tree + session table, and log compaction, so a
   crashed coordinator restarts with its full state.
@@ -42,14 +47,15 @@ from tfidf_tpu.cluster.registry import ServiceRegistry
 from tfidf_tpu.cluster.resilience import (BreakerBoard, CircuitBreaker,
                                           CircuitOpenError, RetryPolicy)
 from tfidf_tpu.cluster.node import SearchNode
-from tfidf_tpu.cluster.placement import PlacementMap
+from tfidf_tpu.cluster.placement import PlacementFollower, PlacementMap
+from tfidf_tpu.cluster.router import QueryRouter
 from tfidf_tpu.cluster.wal import DurableStore
 from tfidf_tpu.cluster.ensemble import EnsembleNode
 
 __all__ = [
     "CoordinationCore", "CoordinationServer", "CoordinationClient",
     "LocalCoordination", "Event", "LeaderElection", "OnElectionCallback",
-    "ServiceRegistry", "SearchNode", "PlacementMap", "RetryPolicy",
-    "CircuitBreaker",
+    "ServiceRegistry", "SearchNode", "PlacementMap", "PlacementFollower",
+    "QueryRouter", "RetryPolicy", "CircuitBreaker",
     "CircuitOpenError", "BreakerBoard", "DurableStore", "EnsembleNode",
 ]

@@ -129,7 +129,16 @@ class AdmissionController:
     evicted flooder merely restarts with a full burst, which the depth
     backpressure still bounds)."""
 
-    def __init__(self, config, depth_fn, clock=time.monotonic) -> None:
+    def __init__(self, config, depth_fn, clock=time.monotonic,
+                 name: str = "") -> None:
+        """``name`` identifies WHICH front door this controller guards
+        (the stateless router tier passes ``router``) — surfaced in
+        :meth:`snapshot` for /api/health. Metric names stay identical
+        across tiers on purpose: each router is its own process, so
+        Prometheus separates tiers by scrape target, not by series
+        name (the per-router queue-depth gauge the HPA consumes is
+        already distinct via the coalescer name)."""
+        self.name = name
         self.enabled = config.admission_enabled
         self.rate_qps = config.admission_rate_qps
         self.burst = (config.admission_burst
@@ -194,7 +203,8 @@ class AdmissionController:
         """Operator view for /api/health (lock-light: counts only)."""
         with self._lock:
             n = len(self._buckets)
-        return {"enabled": self.enabled, "rate_qps": self.rate_qps,
+        return {"enabled": self.enabled, "front_door": self.name,
+                "rate_qps": self.rate_qps,
                 "burst": self.burst, "queue_high_water": self.high_water,
                 "queue_critical": self.critical, "clients_tracked": n}
 

@@ -50,6 +50,7 @@ log = get_logger("cluster.coordination")
 # Event types (names follow ZooKeeper's EventType for recognizability).
 NODE_CREATED = "NodeCreated"
 NODE_DELETED = "NodeDeleted"
+NODE_DATA_CHANGED = "NodeDataChanged"
 CHILDREN_CHANGED = "NodeChildrenChanged"
 SESSION_EXPIRED = "SessionExpired"
 
@@ -174,6 +175,12 @@ class CoordinationCore:
             if op == "set_data":
                 self._resolve(_split(cmd["path"])).data = \
                     bytes.fromhex(cmd.get("data", ""))
+                # ZooKeeper semantics: a data watch set via exists()
+                # fires NodeDataChanged on setData — the placement
+                # follower view (cluster/placement.py) rides this.
+                # Local-only side effect, like the create/delete fires
+                # above: events are not replicated state.
+                self._fire(cmd["path"], "exists", NODE_DATA_CHANGED)
                 return None
             if op == "new_session":
                 sid = self._next_sid

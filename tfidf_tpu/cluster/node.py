@@ -32,54 +32,52 @@ the scatter fan-out is parallel (the reference loops serially,
 
 from __future__ import annotations
 
-import contextlib
-import email.parser
-import email.policy
 import json
-import math
 import os
 import threading
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
-from concurrent.futures import wait as _fwait
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler  # noqa: F401 (re-export)
 
-from tfidf_tpu.cluster.admission import (LANE_BULK, LANE_INTERACTIVE,
-                                         AdmissionController, ResultCache)
+from tfidf_tpu.cluster.admission import (LANE_BULK, AdmissionController,
+                                         ResultCache)
 from tfidf_tpu.cluster.autopilot import Autopilot
 from tfidf_tpu.cluster.batcher import Coalescer, QueryBatcher
 from tfidf_tpu.cluster.coordination import NoNodeError
-from tfidf_tpu.cluster.wire import (pack_hit_lists, pack_topk_arrays,
-                                    unpack_hit_lists)
+from tfidf_tpu.cluster.wire import pack_hit_lists, pack_topk_arrays
 from tfidf_tpu.cluster.election import LeaderElection
 from tfidf_tpu.cluster.fencing import (FENCE_EPOCH_HEADER, FENCE_HEADER,
                                        FENCE_REJECTED_HEADER,
                                        FENCE_STATUS, FenceGuard)
 from tfidf_tpu.cluster.nemesis import global_nemesis
-from tfidf_tpu.cluster.placement import PlacementMap
+from tfidf_tpu.cluster.placement import PlacementFollower, PlacementMap
 from tfidf_tpu.cluster.rebalance import Rebalancer
 from tfidf_tpu.cluster.registry import (ServiceRegistry,
                                         publish_leader_info,
                                         read_leader_info)
-from tfidf_tpu.cluster.resilience import (CircuitOpenError,
-                                          ClusterResilience,
-                                          DeadlineExpired, RpcStatusError,
-                                          hedge_laggards,
+from tfidf_tpu.cluster.resilience import (ClusterResilience,
+                                          RpcStatusError,
                                           is_fence_rejection)
+# the read plane (scatter/merge/failover/hedge spine + the shared HTTP
+# handler plumbing) lives in cluster/router.py — the scale-out query
+# plane: SearchNode hosts it beside its mutation plane; the stateless
+# QueryRouter hosts it alone (router.py imports nothing from this
+# module at load time, so the split is cycle-free)
+from tfidf_tpu.cluster.router import (ScatterReadPlane, _HttpHandlerBase,
+                                      _PlaneServer, _linger_bounds,
+                                      list_routers)
 from tfidf_tpu.engine.engine import Engine
 from tfidf_tpu.ops.analyzer import UnsupportedMediaType
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
-from tfidf_tpu.utils.tracing import (SPAN_HEADER, TRACE_HEADER,
-                                     global_tracer, propagation_headers,
-                                     remote_context, span_event,
-                                     to_chrome_trace)
+from tfidf_tpu.utils.tracing import (global_tracer, propagation_headers,
+                                     span_event)
 
 log = get_logger("cluster.node")
 
@@ -238,30 +236,20 @@ class WorkerDeadline(RuntimeError):
     resilience layer classifies that as non-retryable."""
 
 
-def _linger_bounds(min_ms: float, max_ms: float) -> dict:
-    """Coalescer adaptive-linger kwargs from config (negative = keep
-    the fixed linger; see Config.batch_linger_min_ms)."""
-    if min_ms < 0 or max_ms < 0:
-        return {}
-    return {"linger_min_s": min_ms / 1e3, "linger_max_s": max_ms / 1e3}
+class SearchNode(ScatterReadPlane):
+    """One node: engine + election + registry + HTTP server.
 
-
-def _parse_multipart(body: bytes, content_type: str
-                     ) -> tuple[str | None, bytes]:
-    """Extract (filename, payload) from a multipart/form-data body — the
-    reference accepts Spring ``MultipartFile`` uploads (``Leader.java:153``,
-    ``Worker.java:125``); this keeps ``curl -F file=@doc.txt`` working."""
-    msg = email.parser.BytesParser(policy=email.policy.default).parsebytes(
-        b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body)
-    for part in msg.iter_parts():
-        fn = part.get_filename()
-        if fn is not None:
-            return fn, part.get_payload(decode=True) or b""
-    return None, b""
-
-
-class SearchNode:
-    """One node: engine + election + registry + HTTP server."""
+    Role split (cluster/router.py): the READ plane — the scatter /
+    owner-merge / failover / hedge spine behind ``/leader/start`` and
+    ``/leader/download`` — is inherited from :class:`ScatterReadPlane`
+    and runs on EVERY node; only the placement view differs by role
+    (the elected leader routes reads through its authoritative map, a
+    non-leader through a watch-refreshed follower view of the durable
+    placement znode, so any node serves exact reads without the legacy
+    sum-merge's replica double-count). The MUTATION plane — placement
+    routing, replication, reconcile/repair, rebalance, deletes — runs
+    only on the elected leader; a non-leader forwards front-door
+    mutations to the leader published at ``/leader_info``."""
 
     def __init__(self, config: Config | None = None, coord=None,
                  engine: Engine | None = None, coord_factory=None) -> None:
@@ -397,6 +385,25 @@ class SearchNode:
         self.placement.bind_store(lambda: self.coord)
         # leadership fence on every flush (see PlacementMap.persist_gate)
         self.placement.persist_gate = self.is_leader
+        # scale-out query plane (cluster/router.py): a NON-leader node
+        # serves /leader/start through this read-only follower view of
+        # the durable placement znode (watch-refreshed) instead of its
+        # empty post-demotion map — without it, a worker answering a
+        # read would fall back to the legacy sum-merge across every
+        # replica and silently double-count R-replicated documents.
+        # None when any-node reads are disabled or the map is not
+        # persisted (nothing to follow).
+        self.placement_follower: PlacementFollower | None = None
+        if (self.config.router_any_node_reads
+                and self.config.placement_flush_ms >= 0):
+            self.placement_follower = PlacementFollower(
+                name=f"n{self.config.port}",
+                refresh_ms=self.config.router_refresh_ms,
+                stale_ms=self.config.router_stale_ms)
+            self.placement_follower.bind_store(lambda: self.coord)
+        # elected-leader address cache for the read plane's write
+        # forwarding (ScatterReadPlane.leader_url)
+        self._leader_cache = (0.0, None)
         # aliases kept for the lock-ordering discipline (and tests):
         # _placement/_moved ARE the placement map's dicts, guarded by
         # _placement_lock == placement.lock
@@ -515,6 +522,10 @@ class SearchNode:
             self.engine.build_from_directory(
                 newer_than=rebuild_newer_than)
         self.placement.start_persister()
+        if self.placement_follower is not None:
+            # any-node read plane: follow the durable placement znode
+            # (data watch + periodic backstop — cluster/placement.py)
+            self.placement_follower.start()
         self.election.volunteer_for_leadership()
         self.election.reelect_leader()
         if self._ckpt_thread is not None:
@@ -566,6 +577,8 @@ class SearchNode:
     def stop(self) -> None:
         self._stopping = True
         self.placement.stop()
+        if self.placement_follower is not None:
+            self.placement_follower.stop()
         self.election.resign()
         self.registry.unregister_from_cluster()
         self.httpd.shutdown()
@@ -736,12 +749,27 @@ class SearchNode:
         with self._result_gen_lock:
             self._result_gen += 1
 
-    def df_signature(self) -> tuple[int, int]:
+    def df_signature(self) -> tuple:
         """The result cache's generation token: (membership epoch,
         commit generation). The epoch component covers everything that
         changes WHICH shards answer (worker death/join shifts
         per-shard df); the generation component covers every commit
-        the leader orchestrates on unchanged membership."""
+        the leader orchestrates on unchanged membership.
+
+        A NON-leader serving reads has no view of the leader's commit
+        generation — its token keys on the follower VIEW version
+        instead (tagged so a token minted in one role can never
+        collide with the other): every observed placement flush — the
+        leader flushes after every df-changing commit — invalidates,
+        bounding staleness by the flush debounce + watch latency. The
+        LOCAL commit generation still rides along: a direct
+        ``/worker/*`` write on this node changes its own engine's df
+        without any placement flush (the dual-role contract)."""
+        if self._role != "leader" and self._follower_active():
+            with self._result_gen_lock:
+                gen = self._result_gen
+            return (self._cluster_epoch,
+                    ("view", self.placement_follower.version, gen))
         with self._result_gen_lock:
             gen = self._result_gen
         return (self._cluster_epoch, gen)
@@ -797,6 +825,14 @@ class SearchNode:
                 coord.on_session_event(self._on_session_event)
                 self.election.volunteer_for_leadership()
                 self.election.reelect_leader()
+                if self.placement_follower is not None:
+                    # the old session's data watch died with it: force
+                    # a re-arm + refresh on the NEW client (the store
+                    # getter reads self.coord dynamically) — without
+                    # this the any-node read view would silently fall
+                    # back to poll latency forever
+                    self.placement_follower._watch_armed = False
+                    self.placement_follower._wake.set()
                 global_metrics.inc("session_rejoins")
                 log.info("rejoined cluster after session expiry",
                          url=self.url, leader=self.election.is_leader())
@@ -1062,520 +1098,30 @@ class SearchNode:
             with self._fence_lock:
                 self._fence_stepping = False
 
-    # ---- leader logic (leader/Leader.java) ----
+    # ---- read plane (cluster/router.py ScatterReadPlane) ----
+    #
+    # leader_search / leader_search_with_health / _scatter_search_batch /
+    # _gather_merge (the scatter, owner-merge, failover, and hedge
+    # spine) are inherited from ScatterReadPlane; only the three policy
+    # hooks below are role-dependent.
 
-    def leader_search(self, query: str,
-                      lane: str = LANE_INTERACTIVE) -> dict[str, float]:
-        """Scatter-gather search (``Leader.java:39-92``): fan the query out
-        to every registered worker, tolerate per-worker failure, sum-merge
-        scores by document name.
+    def _follower_active(self) -> bool:
+        """Is the follower view usable for reads? Only once a payload
+        has actually loaded — before the leader's first flush (or with
+        persistence disabled) a non-leader keeps the legacy behavior
+        rather than serving an empty view."""
+        f = self.placement_follower
+        return f is not None and f.loaded
 
-        Default path: concurrent queries coalesce into one batched RPC
-        per worker (:meth:`_scatter_search_batch`). The per-query JSON
-        fan-out below remains for unbounded-results (parity) configs and
-        ``scatter_micro_batch=False``."""
-        return self.leader_search_with_health(query, lane=lane)[0]
-
-    # per-query JSON scatter budget (the reference's 10s RestTemplate
-    # default) — propagated to workers as X-Deadline-Ms like the
-    # batched path's scatter_timeout_s
-    _PER_QUERY_BUDGET_S = 10.0
-
-    def leader_search_with_health(self, query: str,
-                                  lane: str = LANE_INTERACTIVE
-                                  ) -> tuple[dict[str, float], dict]:
-        """``leader_search`` plus this request's OWN health marker —
-        ``(merged, {attempted, responded, circuit_open, degraded,
-        failovers, dark})``. The handler stamps the degraded header
-        from the returned value: reading it back off shared node state
-        would let two concurrent scatters mislabel each other's
-        replies.
-
-        ``lane`` routes the query through the scatter coalescer's
-        weighted dequeue (bulk can never starve interactive). The
-        result cache is consulted first: the generation token is
-        captured BEFORE dispatch, so a commit that lands mid-scatter
-        invalidates the entry this request inserts — a cached result
-        can never be newer-keyed than the corpus state it saw."""
-        token = self.df_signature()
-        if self.result_cache is not None:
-            hit = self.result_cache.get(query, token)
-            if hit is not None:
-                # a cache hit did no fan-out: its health marker says so
-                # (and is never recorded into the shared gauges — it
-                # would misreport the last real scatter's health)
-                return hit, {"attempted": 0, "responded": 0,
-                             "circuit_open": 0, "degraded": 0,
-                             "failovers": 0, "dark": 0, "cached": 1}
-        if self.scatter_batcher is not None:
-            result, health = self.scatter_batcher.submit(
-                query, lane=1 if lane == LANE_BULK else 0)
-            if self.result_cache is not None and not health.get("degraded"):
-                self.result_cache.put(query, token, result)
-            return result, health
-        log.info("scatter search", query=query)
-        body = json.dumps({"query": query}).encode()
-        t_deadline = time.monotonic() + self._PER_QUERY_BUDGET_S
-
-        def rpc_one(addr: str, live: set[str],
-                    deadline: float) -> list[list[tuple[str, float]]]:
-            global_injector.check("leader.worker_rpc")
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                # pre-dispatch: no RPC happens, so the breaker must
-                # record NOTHING (DeadlineExpired releases it)
-                raise DeadlineExpired(addr + ": budget spent")
-            hits = json.loads(self._scatter.post(
-                addr, "/worker/process", body, timeout=remaining,
-                live=live,
-                headers={"X-Deadline-Ms": str(int(remaining * 1e3))}))
-            return [[(h["document"]["name"], float(h["score"]))
-                     for h in hits]]
-
-        merged, health = self._gather_merge([query], rpc_one, t_deadline)
-        result = self._order_merged(merged[0])
-        if self.result_cache is not None and not health.get("degraded"):
-            self.result_cache.put(query, token, result)
-        return result, health
-
-    def _pending_reconcile(self) -> dict[str, frozenset]:
-        """Names moved AWAY from each worker whose rejoin reconcile has
-        not yet succeeded — excluded from that worker's merged hits so
-        the double-count window closes at merge time, not only when the
-        sweep finally lands. (For MAPPED names the owner assignment
-        already ignores non-replica hits structurally; this exclusion
-        covers names outside the map, and keeps the counter honest.)"""
-        return self.placement.pending_moved()
-
-    def _record_scatter_health(self, attempted: int, responded: int,
-                               circuit_open: int, failovers: int = 0,
-                               dark: int = 0,
-                               uncovered_workers: int = 0) -> dict:
-        """Publish one fan-out's health: gauges in /api/metrics plus a
-        last-observed copy on the node (for the CLI summary). Returns
-        the marker dict — the handler stamps the degraded header from
-        the RETURNED value, which belongs to this request alone.
-
-        ``degraded`` means the RESULTS may be incomplete — not merely
-        that a worker failed. A worker death fully absorbed by replica
-        failover (every orphaned document re-scored by a surviving
-        replica) yields a complete, non-degraded response; documents
-        with no live scorer (``dark``) or a failed worker outside the
-        placement map's knowledge keep the marker honest."""
-        degraded = 1 if (dark > 0 or uncovered_workers > 0) else 0
-        health = {
-            "attempted": attempted, "responded": responded,
-            "circuit_open": circuit_open, "degraded": degraded,
-            "failovers": failovers, "dark": dark}
-        self._scatter_health = health
-        global_metrics.set_gauge("scatter_last_attempted", attempted)
-        global_metrics.set_gauge("scatter_last_responded", responded)
-        global_metrics.set_gauge("scatter_last_circuit_open", circuit_open)
-        global_metrics.set_gauge("scatter_last_failovers", failovers)
-        global_metrics.set_gauge("scatter_last_dark", dark)
-        global_metrics.set_gauge("scatter_degraded", degraded)
-        global_metrics.set_gauge("breaker_open_workers",
-                                 self.resilience.board.open_count())
-        if failovers:
-            global_metrics.inc("scatter_failovers", failovers)
-        if degraded:
-            global_metrics.inc("degraded_responses")
-        return health
-
-    def _order_merged(self, merged: dict[str, float]) -> dict[str, float]:
-        """Truncate + order one query's sum-merged scores."""
-        if not self.config.unbounded_results:
-            # each document lives on exactly one worker, so the global
-            # top-k is contained in the union of per-worker top-ks —
-            # truncating the merge to k is exact
-            merged = dict(sorted(merged.items(),
-                                 key=lambda kv: (-kv[1], kv[0]))
-                          [:self.config.top_k])
-        if self.config.result_order == "name":
-            # alphabetical, the reference's TreeMap order (Leader.java:80-91)
-            return dict(sorted(merged.items()))
-        return dict(sorted(merged.items(), key=lambda kv: (-kv[1], kv[0])))
-
-    def _scatter_search_batch(
-            self, queries: list[str]) -> list[dict[str, float]]:
-        """Batched scatter-gather: ONE ``/worker/process-batch`` RPC per
-        worker for a whole coalesced query group, packed-binary replies
-        (:mod:`tfidf_tpu.cluster.wire`), per-query owner-merge at the
-        leader (:meth:`_gather_merge`). Collapses the per-(query,
-        worker) HTTP + JSON cost that otherwise caps the distributed
-        path (the reference pays it by design, one RestTemplate POST
-        per worker per query, ``Leader.java:51-70``). A failed worker's
-        ownership slice fails over to surviving replicas WITHIN this
-        request."""
-        body = json.dumps({"queries": queries,
-                           "k": self.config.top_k}).encode()
-        t_deadline = time.monotonic() + self.config.scatter_timeout_s
-
-        def rpc_one(addr: str, live: set[str],
-                    deadline: float) -> list[list[tuple[str, float]]]:
-            global_injector.check("leader.worker_rpc")
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                # the budget is already spent: fail locally instead of
-                # shipping a batch the worker will (rightly) refuse —
-                # and record nothing on the breaker (no RPC happened)
-                raise DeadlineExpired(addr + ": budget spent")
-            t0 = time.perf_counter()
-            raw = self._scatter.post(
-                addr, "/worker/process-batch", body,
-                timeout=remaining, live=live,
-                headers={"X-Deadline-Ms": str(int(remaining * 1e3))})
-            global_metrics.observe("scatter_rpc",
-                                   time.perf_counter() - t0)
-            t1 = time.perf_counter()
-            hit_lists = unpack_hit_lists(raw)
-            global_metrics.observe("scatter_decode",
-                                   time.perf_counter() - t1)
-            return hit_lists
-
-        merged, health = self._gather_merge(queries, rpc_one, t_deadline)
-        t0 = time.perf_counter()
-        # one (result, health) pair per coalesced query: every caller in
-        # the group shares this batch's fan-out, so each reply carries
-        # this batch's marker
-        out = [(self._order_merged(m), health) for m in merged]
-        global_metrics.observe("scatter_merge", time.perf_counter() - t0)
-        return out
-
-    def _slice_call(self, addr: str, queries: list[str],
-                    names: list[str], t_deadline: float,
-                    live: set[str], trace_parent=None,
-                    kind: str = "failover"
-                    ) -> list[list[tuple[str, float]]]:
-        """Failover / hedged read: score the ``names`` ownership slice
-        on a surviving replica (one breaker-gated, retried logical
-        RPC). Exact within the slice — the worker computes the full
-        ranking host-side and filters, so no slice document can be
-        truncated out by documents outside it.
-
-        ``trace_parent`` parents the slice span under the scatter span
-        that dispatched it (the slice pool thread has no ambient
-        context); ``kind`` distinguishes a failover re-issue from a
-        hedged duplicate in the trace."""
-        def rpc() -> list[list[tuple[str, float]]]:
-            global_injector.check("leader.replica_rpc")
-            remaining = t_deadline - time.monotonic()
-            if remaining <= 0:
-                raise DeadlineExpired(addr + ": budget spent")
-            body = json.dumps({"queries": queries,
-                               "names": names}).encode()
-            raw = self._scatter.post(
-                addr, "/worker/process-batch", body,
-                timeout=remaining, live=live,
-                headers={"X-Deadline-Ms": str(int(remaining * 1e3))})
-            return unpack_hit_lists(raw)
-
-        def run():
-            return self.resilience.worker_call(addr, rpc,
-                                               track_latency=True)
-
-        if trace_parent is None:
-            return run()
-        with global_tracer.span(
-                "scatter.slice", parent=trace_parent,
-                attrs={"worker": addr, "kind": kind,
-                       "names": len(names)}):
-            return run()
-
-    def _gather_merge(self, queries: list[str], rpc_one,
-                      t_deadline: float
-                      ) -> tuple[list[dict[str, float]], dict]:
-        """The scatter/merge/failover spine shared by the per-query and
-        batched paths.
-
-        1. Compute this request's OWNER ASSIGNMENT: exactly one live,
-           breaker-closed replica scores each mapped document, so the
-           merge is double-count-free by construction.
-        2. Fan the queries out to every registered worker
-           (breaker-gated, retried, deadline-propagated ``rpc_one``).
-           With ``scatter_hedge_ms`` set, a laggard's ownership slice
-           is speculatively re-issued to the next replica while the
-           primary RPC is still outstanding.
-        3. Merge epoch 0: an owner's hits are ASSIGNED (not summed);
-           non-owner replica hits are dropped; names outside the map
-           keep the legacy sum-merge with pending-reconcile exclusion.
-        4. Failover (epoch 1): documents whose owner failed or was
-           breaker-open are re-issued — only the orphaned ownership
-           slice — to surviving replicas within this same request.
-           Hedge results are deduped by owner epoch: if the primary
-           answered after all, its epoch-0 hits win and the hedge is
-           discarded.
-        """
-        workers = self.registry.get_all_service_addresses()
-        live = set(workers)
-        self.resilience.prune(live)   # breakers + latency EWMAs
-        excluded = self._pending_reconcile()
-        open_set = frozenset(w for w in workers
-                             if self.resilience.board.is_open(w))
-        view = self.placement.owner_assignment(frozenset(live), open_set)
-        # the scatter span this request (or its coalesced batch) is
-        # running under: per-worker RPCs become CHILD spans of it, and
-        # failover/hedge slices parent under it too (the pool threads
-        # have no ambient context of their own). None = untraced; every
-        # tracing call below no-ops.
-        tparent = global_tracer.current()
-        if tparent is not None and not tparent.sampled:
-            tparent = None
-
-        def call(addr: str):
-            # scatter RPCs feed the gray-failure latency EWMA (slow
-            # worker detection is scoped to THIS path — bulk uploads
-            # legitimately take minutes and must not condemn a worker)
-            def run():
-                return self.resilience.worker_call(
-                    addr, lambda: rpc_one(addr, live, t_deadline),
-                    track_latency=True)
-            if tparent is None:
-                return run()
-            with global_tracer.span("scatter.worker", parent=tparent,
-                                    attrs={"worker": addr,
-                                           "queries": len(queries)}):
-                return run()
-
-        futures = {self._pool.submit(call, w): w for w in workers}
-
-        # hedged duplicate reads (The Tail at Scale): per laggard, the
-        # ownership slice goes to the next replica while the primary is
-        # still in flight; the merge below dedups by owner epoch
-        # the hedge delay is the LIVE knob (autopilot-tunable; equals
-        # config.scatter_hedge_ms unless the autopilot moved it),
-        # read once so the guard and the wait agree within a request
-        hedge_ms = self.hedge_ms
-        hedge_futs: dict[str, list[tuple[str, list[str], object]]] = {}
-        if hedge_ms > 0 and view.owned:
-            def dispatch_hedge(addr: str) -> None:
-                names = view.owned.get(addr)
-                if not names:
-                    return
-                global_injector.check("leader.hedge")
-                global_metrics.inc("scatter_hedges")
-                if tparent is not None:
-                    tparent.event("hedge_dispatched", laggard=addr)
-                for backup, ns in self.placement.backups_for(
-                        names, exclude={addr}, live=live,
-                        avoid=open_set).items():
-                    hedge_futs.setdefault(addr, []).append(
-                        (backup, ns, self._slice_pool.submit(
-                            self._slice_call, backup, queries, ns,
-                            t_deadline, live, tparent, "hedge")))
-            hedge_laggards(dict(futures), hedge_ms / 1e3,
-                           dispatch_hedge)
-
-        ok: dict[str, list] = {}
-        failed: set[str] = set()
-        circuit_open = 0
-        for fut, addr in futures.items():
-            try:
-                if addr in hedge_futs:
-                    # the laggard is raced by its hedge: wait for
-                    # WHICHEVER side lands first — a primary that
-                    # answered right after the hedge fired must not
-                    # stall behind a slower hedge slice. The primary
-                    # wins whenever it made it (owner-epoch dedup);
-                    # once every hedge settled it gets only a short
-                    # grace. An abandoned primary that lands later
-                    # still settles its breaker accounting in the pool
-                    # thread; its result is simply not merged.
-                    hset = {hf for _b, _ns, hf in hedge_futs[addr]}
-                    pending = {fut} | hset
-                    while fut in pending and len(pending) > 1:
-                        remaining = t_deadline - time.monotonic() + 30.0
-                        if remaining <= 0:
-                            break
-                        _done, pending = _fwait(
-                            pending, timeout=remaining,
-                            return_when=FIRST_COMPLETED)
-                    hedge_ok = any(
-                        hf.done() and not hf.cancelled()
-                        and hf.exception() is None for hf in hset)
-                    if fut.done() or hedge_ok:
-                        # primary landed, or a successful hedge stands
-                        # ready to supersede it after a short grace
-                        hit_lists = fut.result(timeout=0.05)
-                    else:
-                        # every hedge FAILED (e.g. the backup's breaker
-                        # is open): the hedge bought nothing — wait for
-                        # the still-in-budget primary like an unhedged
-                        # worker instead of abandoning a healthy reply
-                        try:
-                            hit_lists = fut.result(timeout=max(
-                                0.0, t_deadline - time.monotonic())
-                                + 30.0)
-                        except (FutureTimeout, TimeoutError) as e:
-                            raise RuntimeError(
-                                "scatter task stalled past deadline"
-                            ) from e
-                else:
-                    # bounded by the request deadline plus grace for
-                    # the retry policy's backoff sleeps (lockgraph
-                    # indefinite-wait audit: a hung pool task must not
-                    # wedge the scatter thread forever). Re-raised as a
-                    # plain failure so it is NOT mistaken for a hedge
-                    # win below.
-                    try:
-                        hit_lists = fut.result(timeout=max(
-                            0.0, t_deadline - time.monotonic()) + 30.0)
-                    except (FutureTimeout, TimeoutError) as e:
-                        raise RuntimeError(
-                            "scatter task stalled past deadline") from e
-            except (FutureTimeout, TimeoutError):
-                failed.add(addr)
-                won = any(
-                    hf.done() and not hf.cancelled()
-                    and hf.exception() is None
-                    for _b, _ns, hf in hedge_futs.get(addr, ()))
-                if won:
-                    global_metrics.inc("scatter_hedge_wins")
-                    if tparent is not None:
-                        tparent.event("hedge_win", laggard=addr)
-                    log.info("hedge superseded laggard primary",
-                             worker=addr)
-                else:
-                    # every hedge failed too: this is a plain scatter
-                    # failure, not a win — keep the metrics honest
-                    global_metrics.inc("scatter_failures")
-                    log.warning("laggard primary abandoned with no "
-                                "successful hedge", worker=addr)
-                continue
-            except CircuitOpenError:
-                # fast-failed without an RPC: the worker's breaker is
-                # open — counted separately so the health marker can
-                # distinguish "skipped sick worker" from "RPC failed"
-                circuit_open += 1
-                failed.add(addr)
-                global_metrics.inc("scatter_circuit_open")
-                continue
-            except Exception as e:
-                # per-worker tolerance (Leader.java:67-69) — a reply
-                # that fails wire validation degrades exactly like a
-                # failed RPC; failover below recovers the mapped slice
-                failed.add(addr)
-                global_metrics.inc("scatter_failures")
-                log.warning("worker failed during search", worker=addr,
-                            err=repr(e))
-                continue
-            if len(hit_lists) != len(queries):
-                failed.add(addr)
-                global_metrics.inc("scatter_failures")
-                log.warning("batch reply length mismatch", worker=addr)
-                continue
-            ok[addr] = hit_lists
-
-        # ---- merge, epoch 0: owner hits + legacy sum for unmapped ----
-        owner = view.owner
-        legacy_addrs: set[str] = set()   # workers with unmapped hits
-        merged: list[dict[str, float]] = [{} for _ in queries]
-        for addr, hit_lists in ok.items():
-            skip = excluded.get(addr)
-            for m, hits in zip(merged, hit_lists):
-                for name, score in hits:
-                    own = owner.get(name)
-                    if own is not None:
-                        if own == addr:
-                            # exactly one owner scores each mapped doc:
-                            # assignment — the sum-merge cannot double-
-                            # count replicas by construction
-                            m[name] = float(score)
-                        elif skip is not None and name in skip:
-                            # pending-reconcile copy on a rejoiner,
-                            # already structurally ignored — counted so
-                            # operators see the exclusion is active
-                            global_metrics.inc("scatter_hits_excluded")
-                        continue
-                    if skip is not None and name in skip:
-                        # unmapped pending-reconcile copy: the
-                        # survivor's copy already counts (ADVICE r5)
-                        global_metrics.inc("scatter_hits_excluded")
-                        continue
-                    legacy_addrs.add(addr)
-                    m[name] = m.get(name, 0.0) + float(score)
-
-        # ---- failover, epoch 1: re-issue orphaned ownership slices ----
-        orphans = [n for n, w in owner.items() if w in failed]
-        recovered: set[str] = set()
-        if orphans:
-            orphan_set = set(orphans)
-            failed_backups: set[str] = set()
-
-            def consume_slice(backup: str, ns: list[str], fut) -> None:
-                try:
-                    hit_lists = fut.result(timeout=max(
-                        0.0, t_deadline - time.monotonic()) + 30.0)
-                except Exception as e:
-                    failed_backups.add(backup)
-                    global_metrics.inc("scatter_failover_failures")
-                    log.warning("failover slice failed", worker=backup,
-                                names=len(ns), err=repr(e))
-                    return
-                if len(hit_lists) != len(queries):
-                    failed_backups.add(backup)
-                    global_metrics.inc("scatter_failover_failures")
-                    return
-                ns_set = set(ns) & orphan_set
-                for m, hits in zip(merged, hit_lists):
-                    for name, score in hits:
-                        # owner-epoch dedup: only docs whose owner
-                        # actually failed, first slice writer wins
-                        if name in ns_set and name not in m:
-                            m[name] = float(score)
-                recovered.update(ns_set)
-
-            # phase 1 — hedges already in flight for failed primaries
-            # ARE the failover slices: consume their OUTCOMES first
-            for laggard, entries in hedge_futs.items():
-                if laggard not in failed:
-                    continue   # primary answered: epoch-0 wins
-                for backup, ns, fut in entries:
-                    if backup in failed:
-                        continue
-                    consume_slice(backup, ns, fut)
-            # phase 2 — anything a hedge did NOT actually deliver
-            # (never dispatched, or the hedge itself failed) gets a
-            # fresh slice to the next usable replica: a failed hedge
-            # must not suppress re-issue to a remaining live one
-            fresh = [n for n in orphans if n not in recovered]
-            if fresh:
-                fresh_pending = [
-                    (backup, ns, self._slice_pool.submit(
-                        self._slice_call, backup, queries, ns,
-                        t_deadline, live, tparent, "failover"))
-                    for backup, ns in self.placement.backups_for(
-                        fresh, exclude=failed | failed_backups,
-                        live=live, avoid=open_set).items()]
-                for backup, ns, fut in fresh_pending:
-                    consume_slice(backup, ns, fut)
-
-        dark = len(view.dark) + len([n for n in orphans
-                                     if n not in recovered])
-        # a failed worker OUTSIDE the placement map may hold documents
-        # the map cannot fail over — stay honest and mark degraded.
-        # Same when unmapped documents are in play: legacy sum-merge
-        # hits flowing THIS request, or a failed worker that has EVER
-        # served unmapped hits (its copies may have been the only ones,
-        # so their absence right now proves nothing).
-        now = time.monotonic()
-        for a in legacy_addrs:
-            self._legacy_hit_workers[a] = now
-        uncovered_workers = sum(1 for w in failed
-                                if w not in view.replica_workers)
-        if failed and (legacy_addrs
-                       or any(w in self._legacy_hit_workers
-                              for w in failed)):
-            uncovered_workers += 1
-        health = self._record_scatter_health(
-            len(workers), len(ok), circuit_open,
-            failovers=len(recovered), dark=dark,
-            uncovered_workers=uncovered_workers)
-        if tparent is not None:
-            # the request story's verdict, on the scatter span itself:
-            # chaos suites assert degraded/failover counts from here
-            tparent.event("scatter.health", **health)
-        return merged, health
+    def _read_placement(self):
+        """The placement view one read request routes under: the
+        authoritative map while this node leads; the watch-refreshed
+        follower view of the durable znode otherwise. The cached role
+        is used (never an is_leader() coordination READ — this is the
+        per-request hot path); transitions re-point the next request."""
+        if self._role == "leader" or not self._follower_active():
+            return self.placement
+        return self.placement_follower
 
     # ---- shard recovery (SURVEY §5.3 — beyond the reference) ----
 
@@ -2612,146 +2158,61 @@ class SearchNode:
         finally:
             stream.close()
 
+    def read_download_stream(self, rel: str):
+        """The read plane's download locator (the shared
+        ``/leader/download`` handler calls this on every host): a node
+        serves from its engine + durable store, then probes workers."""
+        return self.leader_download_stream(rel)
 
-class _NodeServer(ThreadingHTTPServer):
-    daemon_threads = True
-    # the socketserver default backlog (5) refuses connections under a
-    # concurrent-client burst; a node serves many clients at once
-    request_queue_size = 256
+    # ---- mutation-plane role gate (cluster/router.py) ----
+
+    def _should_forward_writes(self) -> bool:
+        """Should this node forward a front-door mutation to the
+        elected leader instead of serving it? True only for a
+        NON-leader with a known, distinct leader — the mutation plane
+        (placement routing, replication bookkeeping, cache
+        invalidation) is leader-only state, and a worker accepting an
+        upload would place documents its leader's map never learns
+        about. When no leader is published (mid-election) the legacy
+        local path still answers rather than failing closed."""
+        if not self.config.router_forward_writes \
+                or self._role == "leader":
+            return False
+        leader = self.leader_url()
+        return bool(leader) and leader.rstrip("/") != self.url
+
+    def read_plane_snapshot(self) -> dict:
+        """``GET /api/router`` on a node: which placement world this
+        node's read plane routes under (the CLI routers summary
+        compares routers' views against the leader's)."""
+        out = {"role": self._role, "url": self.url}
+        if self._role == "leader":
+            with self._placement_lock:
+                docs = len(self._placement)
+            out["placement"] = {"authoritative": True, "docs": docs,
+                                "epoch": self.placement.epoch,
+                                "gen": self.placement.gen}
+        elif self._follower_active():
+            out["placement"] = dict(
+                self.placement_follower.view_snapshot(),
+                authoritative=False)
+        else:
+            out["placement"] = {"authoritative": False, "loaded": False}
+        return out
 
 
-class _NodeHandler(BaseHTTPRequestHandler):
+# the shared threaded HTTP server (cluster/router.py); the old name is
+# kept for tests and embedding code
+_NodeServer = _PlaneServer
+
+
+class _NodeHandler(_HttpHandlerBase):
+    """The symmetric node's HTTP surface: the shared read-plane routes
+    (search / download / metrics / traces — cluster/router.py) plus
+    the worker data plane, the leadership fence, and the leader-only
+    ops endpoints."""
+
     node: SearchNode   # bound by SearchNode.__init__
-    protocol_version = "HTTP/1.1"
-    # the handler's wfile is unbuffered (wbufsize=0): status line, each
-    # header, and the body go out as separate small writes — with Nagle
-    # on, write N+1 can stall behind the peer's delayed ACK of write N
-    disable_nagle_algorithm = True
-
-    def log_message(self, fmt, *args):
-        pass
-
-    # ---- plumbing ----
-
-    def _send(self, code: int, body: bytes,
-              ctype: str = "application/json",
-              headers: dict[str, str] | None = None) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        headers = headers or {}
-        for k, v in headers.items():
-            self.send_header(k, v)
-        # every response produced inside a request span carries its
-        # trace id — uploads, deletes, downloads, and 429 sheds
-        # included, not just /leader/start (the documented contract:
-        # any /leader/* reply's X-Trace-Id keys `tfidf_tpu trace`)
-        if TRACE_HEADER not in headers:
-            sp = global_tracer.current()
-            if sp is not None:
-                self.send_header(TRACE_HEADER, sp.trace_id)
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _json(self, obj, code: int = 200,
-              headers: dict[str, str] | None = None) -> None:
-        self._send(code, json.dumps(obj).encode(), headers=headers)
-
-    def _text(self, s: str, code: int = 200) -> None:
-        self._send(code, s.encode(), "text/plain; charset=utf-8")
-
-    def _body(self) -> bytes:
-        n = int(self.headers.get("Content-Length", "0"))
-        return self.rfile.read(n) if n else b""
-
-    def _query_param(self, u, name: str) -> str | None:
-        vals = urllib.parse.parse_qs(u.query).get(name)
-        return vals[0] if vals else None
-
-    def _read_upload(self, u) -> tuple[str | None, bytes]:
-        body = self._body()
-        ctype = self.headers.get("Content-Type", "")
-        if ctype.startswith("multipart/form-data"):
-            return _parse_multipart(body, ctype)
-        return self._query_param(u, "name"), body
-
-    # ---- tracing plumbing (utils/tracing.py) ----
-
-    def _remote_ctx(self, trusted: bool):
-        """The propagated trace context from the request headers, or
-        None for an untraced request. ``trusted`` distinguishes the
-        leader→worker continuation (sampling decided upstream) from
-        front-door headers (subject to this node's own draw)."""
-        return remote_context(self.headers.get(TRACE_HEADER),
-                              self.headers.get(SPAN_HEADER),
-                              trusted=trusted)
-
-    @contextlib.contextmanager
-    def _request_span(self, name: str, **attrs):
-        """Span for one handled front-door request: keeps the caller's
-        trace id when headers are present (UNTRUSTED — recording still
-        subject to this node's sampling draw), else mints a new ROOT
-        trace — the admission point where every client request's
-        trace id is born. The span is remembered on the handler so the
-        outer 500 path can still stamp the reply/log with the trace id
-        AFTER the contextvar is reset (failed requests are the ones
-        operators most need to trace)."""
-        with global_tracer.span(
-                name, parent=self._remote_ctx(trusted=False),
-                attrs=attrs or None) as sp:
-            self._last_span = sp
-            yield sp
-
-    def _worker_span(self, name: str, **attrs):
-        """Worker-endpoint span: created ONLY when the caller sent a
-        trace context (the leader's propagated scatter — trusted, the
-        sampling decision was made at the root). External/reference
-        clients (and local benches) hitting /worker/* directly stay
-        untraced — the worker plane adds zero per-request tracing cost
-        unless the leader asked."""
-        ctx = self._remote_ctx(trusted=True)
-        if ctx is None:
-            return contextlib.nullcontext()
-        return global_tracer.span(name, parent=ctx, attrs=attrs or None)
-
-    @contextlib.contextmanager
-    def _admitted(self, name: str, default_lane: str):
-        """The front-door prologue every /leader/* handler shares:
-        resolve the client lane, open the request span, admit-or-shed
-        BEFORE the body is read or any work queues. Yields
-        ``(span, lane)`` when admitted; ``(None, lane)`` when the shed
-        reply was already sent (the caller just returns)."""
-        client, lane = self._client_lane(default_lane)
-        with self._request_span(name, lane=lane) as sp:
-            decision = self.node.admission.admit(client, lane)
-            if not decision.admitted:
-                self._shed(decision)
-                yield None, lane
-            else:
-                yield sp, lane
-
-    def _deadline_header(self) -> float | None:
-        """``X-Deadline-Ms`` (the leader's remaining scatter budget) as
-        a local monotonic deadline; None when absent or malformed."""
-        dl = self.headers.get("X-Deadline-Ms")
-        if dl is None:
-            return None
-        try:
-            return time.monotonic() + float(dl) / 1e3
-        except ValueError:
-            return None
-
-    def _past_deadline(self) -> bool:
-        """Refuse (504 + ``X-Deadline-Exceeded``) when the propagated
-        budget is already spent; True when the reply was sent."""
-        d = self._deadline_header()
-        if d is not None and time.monotonic() > d:
-            global_metrics.inc("worker_deadline_refusals")
-            self._send(504, b"deadline exceeded",
-                       "text/plain; charset=utf-8",
-                       headers={"X-Deadline-Exceeded": "1"})
-            return True
-        return False
 
     def _fence_check(self) -> bool:
         """Leadership fence on the mutating worker endpoints
@@ -2784,79 +2245,6 @@ class _NodeHandler(BaseHTTPRequestHandler):
                             FENCE_EPOCH_HEADER: str(current)})
         return True
 
-    # ---- admission plumbing (cluster/admission.py) ----
-
-    def _client_lane(self, default_lane: str) -> tuple[str, str]:
-        """(client id, lane) for admission: the ``X-Client-Id`` header
-        (falling back to the peer IP) and the ``X-Priority`` header
-        (``bulk`` selects the bulk lane; anything else keeps the
-        endpoint's default)."""
-        client = self.headers.get("X-Client-Id") or self.client_address[0]
-        prio = (self.headers.get("X-Priority") or "").strip().lower()
-        lane = LANE_BULK if prio == "bulk" else (
-            LANE_INTERACTIVE if prio == "interactive" else default_lane)
-        return client, lane
-
-    def _shed(self, decision) -> None:
-        """The explicit shed path: 429 + ``Retry-After``. The header
-        carries RFC 9110 delta-seconds (an integer — fractional values
-        are rejected or silently dropped by standards-compliant
-        clients), rounded UP so an obedient client is never early; the
-        JSON body's ``retry_after_s`` keeps the precise time-to-next-
-        token the rate-limit path computed. ``Connection: close`` is
-        explicit — the request body may be undrained, and a shedding
-        node must not hold keep-alive state for a client it just told
-        to go away (the header also tells pooled clients to drop the
-        connection instead of tripping over the server-side close).
-        The request body is drained up to a 1 MB cap first: closing
-        with unread data in the receive queue sends RST, which can
-        discard the 429 still in the client's buffer — the client
-        would see ECONNRESET, classify it transient, and retry with
-        no Retry-After floor, the exact hammering the shed exists to
-        stop. Beyond the cap the connection closes anyway (a shedding
-        node cannot hold the line for an arbitrarily large upload)."""
-        self.close_connection = True
-        try:
-            remaining = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            remaining = 0
-        remaining = min(remaining, 1 << 20)
-        while remaining > 0:
-            chunk = self.rfile.read(min(remaining, 1 << 16))
-            if not chunk:
-                break
-            remaining -= len(chunk)
-        body = json.dumps({"error": "overloaded",
-                           "reason": decision.reason,
-                           "retry_after_s": round(
-                               decision.retry_after_s, 3)}).encode()
-        self._send(429, body, headers={
-            "Retry-After": str(math.ceil(max(decision.retry_after_s,
-                                             0.0))),
-            "Connection": "close",
-            "X-Shed-Reason": decision.reason})
-
-    def _read_query(self) -> str:
-        """The search query: accept raw text (the reference POSTs the bare
-        query string, ``Leader.java:54-59``) or ``{"query": ...}`` JSON."""
-        body = self._body().decode("utf-8", "replace")
-        # only attempt JSON when the body can be JSON — this is the
-        # per-request hot path, and a raised-and-caught JSONDecodeError
-        # per query is measurable at thousands of q/s. Strip leading
-        # whitespace first: json.loads tolerates it, so the gate must too
-        if body[:1].isspace():
-            body = body.lstrip()
-        if body[:1] in ('{', '"'):
-            try:
-                obj = json.loads(body)
-                if isinstance(obj, dict) and "query" in obj:
-                    return str(obj["query"])
-                if isinstance(obj, str):
-                    return obj
-            except json.JSONDecodeError:
-                pass
-        return body
-
     # ---- routing ----
 
     def do_GET(self) -> None:
@@ -2888,23 +2276,9 @@ class _NodeHandler(BaseHTTPRequestHandler):
             elif u.path == "/leader/download":
                 # the front door guards every /leader/* endpoint:
                 # checkpoint downloads are bulk transfers (real file
-                # I/O per request), first to shed under backpressure
-                with self._admitted("leader.download",
-                                    LANE_BULK) as (sp, _lane):
-                    if sp is None:
-                        return
-                    rel = urllib.parse.unquote(
-                        self._query_param(u, "path") or "")
-                    sp.set_attr("file", rel)
-                    try:
-                        got = node.leader_download_stream(rel)
-                    except PermissionError:
-                        self._text("invalid path", 400)
-                        return
-                    if got is None:
-                        self._text("not found", 404)
-                    else:
-                        self._stream(*got)
+                # I/O per request), first to shed under backpressure —
+                # the shared read-plane branch (cluster/router.py)
+                self._serve_leader_download(u)
             elif u.path == "/api/status":
                 # same phrasing as Controllers.java:25-29
                 self._text("I am the leader" if node.is_leader()
@@ -2948,67 +2322,29 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     n = 50
                 self._json({"autopilot": node.autopilot.snapshot(),
                             "decisions": node.autopilot.decisions(n)})
-            elif u.path in ("/api/metrics", "/metrics"):
-                # /metrics is the conventional Prometheus scrape path
-                # (deploy/k8s.yaml annotates it); /api/metrics keeps
-                # the ad-hoc JSON and answers ?format=prometheus too.
-                # Neither is admission-controlled (observability lane).
-                fmt = self._query_param(u, "format")
-                if u.path == "/metrics" or fmt == "prometheus":
-                    body = global_metrics.render_prometheus(
-                        extra_gauges={
-                            "breaker_open_workers_now":
-                                node.resilience.board.open_count()})
-                    self._send(body=body.encode(), code=200,
-                               ctype="text/plain; version=0.0.4; "
-                                     "charset=utf-8")
-                    return
-                snap = global_metrics.snapshot()
-                # live per-worker breaker states beside the counters —
-                # the CLI's degraded summary reads these
-                states = node.resilience.board.snapshot()
-                if states:
-                    snap["breaker_states"] = states
-                self._json(snap)
-            elif u.path == "/api/trace" or u.path.startswith(
-                    "/api/trace/"):
-                # trace export (observability lane, never admission-
-                # controlled): /api/trace/<trace-id> reconstructs one
-                # request's story (link-following pulls in the batch
-                # trace it coalesced into); /api/trace?recent=N lists
-                # the newest finished spans. ?format=chrome renders
-                # Chrome-trace/Perfetto JSON.
-                tid = u.path[len("/api/trace/"):] \
-                    if u.path.startswith("/api/trace/") else \
-                    (self._query_param(u, "id") or "")
-                if tid:
-                    spans = global_tracer.get_trace(tid)
-                else:
-                    try:
-                        n = int(self._query_param(u, "recent") or 100)
-                    except ValueError:
-                        n = 100
-                    spans = global_tracer.recent(n)
-                if self._query_param(u, "format") == "chrome":
-                    self._json(to_chrome_trace(spans))
-                else:
-                    self._json({"trace_id": tid or None,
-                                "spans": spans})
+            elif u.path == "/api/router":
+                # which placement world this node's read plane routes
+                # under (leader: the authoritative map; worker: its
+                # follower view) — the CLI routers summary compares
+                # router views against the leader's answer here
+                self._json(node.read_plane_snapshot())
+            elif u.path == "/api/routers":
+                # the registered stateless-router tier (ephemeral
+                # znodes under /router_registry — cluster/router.py)
+                self._json(list_routers(node.coord))
+            elif self._serve_metrics(u):
+                # /metrics + /api/metrics: the shared exposition branch
+                # (cluster/router.py; observability lane, never
+                # admission-controlled)
+                pass
+            elif self._serve_trace(u):
+                # trace export: the shared branch (cluster/router.py;
+                # observability lane, never admission-controlled)
+                pass
             else:
                 self._text("not found", 404)
         except Exception as e:
-            # the request span's contextvar is gone by now; the
-            # remembered span keys the error reply + log line so a
-            # FAILED request stays joinable with its recorded
-            # (error-attributed) span
-            sp = self._last_span
-            kv = {"trace": sp.trace_id} if sp is not None else {}
-            log.warning("request failed", path=u.path, err=repr(e),
-                        **kv)
-            self._send(500, f"error: {e!r}".encode(),
-                       "text/plain; charset=utf-8",
-                       headers={TRACE_HEADER: sp.trace_id}
-                       if sp is not None else None)
+            self._fail_500(u, e)
 
     def do_POST(self) -> None:
         u = urllib.parse.urlparse(self.path)
@@ -3205,7 +2541,12 @@ class _NodeHandler(BaseHTTPRequestHandler):
                 # backpressure, so ingest never crowds out interactive
                 # search latency (admit BEFORE reading the body — a
                 # shed upload pays at most the 1 MB drain in _shed,
-                # never a JSON parse or an index slot)
+                # never a JSON parse or an index slot). Mutations stay
+                # on the elected leader: a non-leader forwards instead
+                # of mutating state its leader's map never learns of.
+                if node._should_forward_writes():
+                    self._forward_write(u)
+                    return
                 with self._admitted("leader.upload_batch",
                                     LANE_BULK) as (sp, _lane):
                     if sp is None:
@@ -3218,60 +2559,22 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     except ValueError as e:  # malformed client payload
                         self._text(str(e), 400)
             elif u.path == "/leader/start":
-                # front-door admission BEFORE any work is queued: a
-                # shed request costs one token-bucket check, not a
-                # coalescer slot (searches default to the interactive
-                # lane; X-Priority: bulk selects the bulk lane, which
-                # backpressure sheds first). The request's trace span
-                # is minted HERE — the admission point — so even a
-                # shed request has a trace id, and the span is active
-                # through admission/cache/coalesce/scatter beneath.
-                t0 = time.perf_counter()
-                with self._admitted("leader.search",
-                                    LANE_INTERACTIVE) as (sp, lane):
-                    if sp is None:
-                        return
-                    query = self._read_query()
-                    result, health = node.leader_search_with_health(
-                        query, lane=lane)
-                    # degraded marker: the body stays reference-
-                    # compatible (name -> score); the headers say
-                    # whether every live worker's shard is represented
-                    # and which trace reconstructs this request
-                    hdrs = {TRACE_HEADER: sp.trace_id}
-                    if health.get("cached"):
-                        sp.set_attr("cached", 1)
-                    sp.set_attr("degraded", health.get("degraded", 0))
-                    if health.get("degraded"):
-                        hdrs["X-Scatter-Degraded"] = (
-                            "attempted={attempted} "
-                            "responded={responded} "
-                            "circuit_open={circuit_open} "
-                            "failovers={failovers} dark={dark}"
-                            .format(failovers=health.get("failovers", 0),
-                                    dark=health.get("dark", 0), **{
-                                        k: health[k] for k in
-                                        ("attempted", "responded",
-                                         "circuit_open")}))
-                    dt = time.perf_counter() - t0
-                    # live front-door latency histogram: the p50/p99
-                    # operators (and bench.py's cross-validation) read
-                    global_metrics.observe("leader_search", dt)
-                    slow_ms = node.config.trace_slow_query_ms
-                    if slow_ms > 0 and dt * 1e3 >= slow_ms:
-                        # trace-id-keyed slow-query log: the adapter
-                        # stamps trace=<id> (the span is active here),
-                        # so this line joins with /api/trace/<id>
-                        global_metrics.inc("slow_queries")
-                        log.warning(
-                            "slow query", ms=round(dt * 1e3, 1),
-                            query=query[:80],
-                            degraded=health.get("degraded", 0))
-                    self._json(result, headers=hdrs)
+                # the shared read-plane search branch
+                # (cluster/router.py): front-door admission BEFORE any
+                # work is queued, the trace span minted at the
+                # admission point, the degraded header + (epoch,
+                # generation) route stamp on the reply. Served by
+                # EVERY node — a non-leader routes through its
+                # placement follower view.
+                self._serve_search()
             elif u.path == "/leader/delete":
                 # placement-aware cluster-wide deletion (the upsert/
                 # delete/search partition workload's delete leg); bulk
-                # lane like every other mutating front-door endpoint
+                # lane like every other mutating front-door endpoint.
+                # Mutation plane: non-leaders forward to the leader.
+                if node._should_forward_writes():
+                    self._forward_write(u)
+                    return
                 with self._admitted("leader.delete",
                                     LANE_BULK) as (sp, _lane):
                     if sp is None:
@@ -3283,6 +2586,9 @@ class _NodeHandler(BaseHTTPRequestHandler):
                     self._json(node.leader_delete(
                         [str(n) for n in names]))
             elif u.path == "/leader/upload":
+                if node._should_forward_writes():
+                    self._forward_write(u)
+                    return
                 with self._admitted("leader.upload",
                                     LANE_BULK) as (sp, _lane):
                     if sp is None:
@@ -3304,63 +2610,7 @@ class _NodeHandler(BaseHTTPRequestHandler):
             else:
                 self._text("not found", 404)
         except Exception as e:
-            # the request span's contextvar is gone by now; the
-            # remembered span keys the error reply + log line so a
-            # FAILED request stays joinable with its recorded
-            # (error-attributed) span
-            sp = self._last_span
-            kv = {"trace": sp.trace_id} if sp is not None else {}
-            log.warning("request failed", path=u.path, err=repr(e),
-                        **kv)
-            self._send(500, f"error: {e!r}".encode(),
-                       "text/plain; charset=utf-8",
-                       headers={TRACE_HEADER: sp.trace_id}
-                       if sp is not None else None)
-
-    _STREAM_CHUNK = 1 << 16
-
-    def _stream(self, stream, size: int | None) -> None:
-        """Chunked-copy a readable stream to the client with constant
-        memory (Content-Length when known, else chunked encoding).
-
-        Once the 200 status line is on the wire a failure can no longer
-        become a 500 — writing another status line would inject bytes
-        into the declared payload and hand the client a silently
-        truncated-then-corrupted file. Mid-stream errors instead ABORT
-        the connection (close without the terminating chunk / short of
-        Content-Length), which every HTTP client detects as a transfer
-        error."""
-        try:
-            self.send_response(200)
-            self.send_header("Content-Type", "application/octet-stream")
-            sp = global_tracer.current()
-            if sp is not None:   # stream replies bypass _send; same
-                self.send_header(TRACE_HEADER, sp.trace_id)  # contract
-            chunked = size is None
-            if chunked:
-                self.send_header("Transfer-Encoding", "chunked")
-            else:
-                self.send_header("Content-Length", str(size))
-            self.end_headers()
-            try:
-                while True:
-                    buf = stream.read(self._STREAM_CHUNK)
-                    if not buf:
-                        break
-                    if chunked:
-                        self.wfile.write(b"%x\r\n" % len(buf))
-                        self.wfile.write(buf)
-                        self.wfile.write(b"\r\n")
-                    else:
-                        self.wfile.write(buf)
-                if chunked:
-                    self.wfile.write(b"0\r\n\r\n")
-            except Exception as e:
-                log.warning("download stream aborted mid-transfer",
-                            err=repr(e))
-                self.close_connection = True
-        finally:
-            stream.close()
+            self._fail_500(u, e)
 
     def _download_from_engine(self, u) -> None:
         # URL-decode + traversal check live in Engine._safe_doc_path

@@ -876,6 +876,11 @@ class PlacementMap:
             "v": 2,
             "replicas": reps,
             "moved": {w: sorted(ns) for w, ns in self.moved.items() if ns},
+            # the writing leader's in-memory map generation: follower
+            # views (PlacementFollower) report it so operators can see
+            # each router's lag behind the leader in GENERATIONS, not
+            # just wall-clock age (additive; old payloads load fine)
+            "gen": self.gen,
         }
         if self.epoch is not None:
             # the writing leader's fencing epoch: audited by operators,
@@ -944,3 +949,197 @@ class PlacementMap:
         log.info("placement map loaded from coordination substrate",
                  docs=n, moved_workers=len(moved))
         return n
+
+
+class PlacementFollower(PlacementMap):
+    """Read-only follower view of the durable placement znode — the
+    scale-out query plane's routing table (cluster/router.py).
+
+    The leader's :class:`PlacementMap` is authoritative, leader-memory
+    + durable znode; every OTHER read-serving party — a dedicated
+    stateless router, or a non-leader node answering ``/leader/start``
+    — routes through one of these instead: the znode payload is loaded
+    wholesale (REPLACE semantics, never the new-leader merge of
+    :meth:`PlacementMap.load`), a data watch on the znode triggers a
+    refresh the moment the leader flushes (``NodeDataChanged``, armed
+    via ``exists`` and re-armed after every fire — one-shot semantics),
+    and a periodic pass re-reads as a missed-watch backstop. Writes
+    never happen here: persistence is structurally disabled and the
+    mutating entry points are unused by the read plane.
+
+    **Staleness is tracked, not hidden.** ``version`` bumps on every
+    observed payload change (the router's result-cache token rides it);
+    ``loaded_epoch``/``loaded_gen`` echo the writing leader's fencing
+    epoch and map generation so operators can read each router's lag;
+    and when the view cannot be confirmed fresh — the coordinator is
+    unreachable (every refresh failing) or a test froze the view — for
+    longer than ``stale_ms``, :meth:`suspect` turns True and the read
+    plane marks every response degraded (``X-Scatter-Degraded`` with
+    ``stale_view=1``) and stops serving from its result cache. The
+    marker self-heals on the next successful refresh.
+
+    ``freeze()`` is the deterministic nemesis hook: it pins the view
+    exactly like a coordinator partition would (refreshes fail, the
+    watch never fires through), without needing the HTTP transport.
+    """
+
+    def __init__(self, name: str = "", refresh_ms: float = 1000.0,
+                 stale_ms: float = 5000.0) -> None:
+        super().__init__(flush_ms=-1.0, name=name)   # never persists
+        self._refresh_s = max(refresh_ms, 10.0) / 1e3
+        self._stale_s = stale_ms / 1e3
+        self.version = 0          # bumped per observed payload change
+        self.loaded = False       # a payload has been installed
+        self.loaded_epoch: int | None = None
+        self.loaded_gen = -1
+        self._started = False
+        self._last_ok: float | None = None
+        self._last_raw: bytes | None = None
+        self._frozen = False
+        self._watch_armed = False
+        self._refresher: threading.Thread | None = None
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        """Arm the watch + start the periodic refresh backstop. One
+        immediate refresh runs on the caller's thread so a router that
+        could reach the coordinator at boot serves from a real view
+        from its first request."""
+        if self._started or self._coord_getter is None:
+            return
+        self._started = True
+        try:
+            self.refresh()
+        except Exception as e:
+            log.warning("initial placement view refresh failed",
+                        err=repr(e))
+        self._refresher = threading.Thread(
+            target=self._refresh_loop, daemon=True,
+            name=f"placement-follow-{self._name}")
+        self._refresher.start()
+
+    def _refresh_loop(self) -> None:
+        # bounded waits + stop re-checks (the lockgraph indefinite-wait
+        # audit's contract); the watch event sets _wake so a flush on
+        # the leader propagates at watch latency, not poll latency
+        while not self._stopping:
+            self._wake.wait(timeout=self._refresh_s)
+            self._wake.clear()
+            if self._stopping:
+                return
+            try:
+                self.refresh()
+            except Exception as e:
+                global_metrics.inc("router_view_refresh_failures")
+                log.warning("placement view refresh failed", err=repr(e))
+
+    # ---- the follower read path ----
+
+    def _on_event(self, _ev) -> None:
+        """Watch fire (watch-dispatch thread — hand off fast): the
+        one-shot registration is consumed; wake the refresh loop,
+        which re-reads and re-arms. Never refresh inline here — the
+        read is a coordination RPC and would stall every other
+        client's events behind it."""
+        self._watch_armed = False
+        self._wake.set()
+
+    def refresh(self) -> bool:
+        """One follower pass: (re-)arm the data watch, read the znode,
+        install the payload if it changed. Returns True when the view
+        was confirmed current (payload read, changed or not). A frozen
+        view (the deterministic partition hook) fails exactly like an
+        unreachable coordinator."""
+        global_injector.check("router.view_refresh")
+        if self._frozen:
+            global_metrics.inc("router_view_refresh_failures")
+            return False
+        coord = self._store()
+        if not self._watch_armed:
+            # arm BEFORE the read: a flush landing between the read and
+            # a later arm would be invisible until the periodic backstop
+            coord.exists(PLACEMENT_STATE, watcher=self._on_event)
+            self._watch_armed = True
+        try:
+            raw = coord.get_data(PLACEMENT_STATE)
+        except NoNodeError:
+            raw = None   # pre-first-flush cluster: an EMPTY view is
+            #              current, not a failure
+        self._last_ok = time.monotonic()
+        global_metrics.inc("router_view_refreshes")
+        if raw != self._last_raw:
+            self._install(raw)
+            self._last_raw = raw
+        return True
+
+    def _install(self, raw: bytes | None) -> None:
+        """REPLACE the in-memory view with one payload (never merge:
+        a follower has no local truth to preserve)."""
+        state = json.loads(raw.decode()) if raw else {}
+        reps = {n: tuple(ws)
+                for n, ws in state.get("replicas", {}).items()}
+        moved = {w: set(ns) for w, ns in state.get("moved", {}).items()}
+        with self.lock:
+            self.replicas = reps
+            self._confirmed = {n: set(ws) for n, ws in reps.items()}
+            self.moved = moved
+            self.draining = set(state.get("draining", ()))
+            self._owner_cache = None
+            self.gen += 1
+            self.loaded = True
+            self.loaded_epoch = state.get("epoch")
+            self.loaded_gen = int(state.get("gen", -1))
+            self.version += 1
+        global_metrics.set_gauge("router_placement_version",
+                                 self.version)
+        global_metrics.set_gauge("router_placement_docs", len(reps))
+        global_metrics.set_gauge("router_placement_gen",
+                                 self.loaded_gen)
+        if self.loaded_epoch is not None:
+            global_metrics.set_gauge("router_placement_epoch",
+                                     self.loaded_epoch)
+        log.info("placement view refreshed", docs=len(reps),
+                 version=self.version, epoch=self.loaded_epoch,
+                 gen=self.loaded_gen)
+
+    # ---- staleness honesty ----
+
+    def freeze(self) -> None:
+        """Deterministic partition hook (tests / nemesis suites): pin
+        the view — refreshes fail until :meth:`unfreeze`."""
+        self._frozen = True
+
+    def unfreeze(self) -> None:
+        self._frozen = False
+        self._wake.set()   # self-heal on the next loop pass
+
+    def age_s(self) -> float | None:
+        """Seconds since the view was last CONFIRMED current (None
+        before the first successful refresh)."""
+        if self._last_ok is None:
+            return None
+        return time.monotonic() - self._last_ok
+
+    def suspect(self) -> bool:
+        """True when the view can no longer be vouched for: the
+        follower is running but has not confirmed the znode within
+        ``stale_ms`` (coordinator partition, frozen view, or never
+        reachable since start)."""
+        if not self._started or self._stale_s <= 0:
+            return False
+        age = self.age_s()
+        return age is None or age > self._stale_s
+
+    def view_snapshot(self) -> dict:
+        """Operator view for ``/api/router`` and the CLI routers
+        summary: where this view sits vs the leader's map."""
+        with self.lock:
+            docs = len(self.replicas)
+        age = self.age_s()
+        return {"loaded": self.loaded, "docs": docs,
+                "version": self.version, "epoch": self.loaded_epoch,
+                "gen": self.loaded_gen,
+                "age_s": round(age, 3) if age is not None else None,
+                "stale": bool(self.suspect()),
+                "frozen": self._frozen}

@@ -317,6 +317,34 @@ class Config:
     # 0 disables the cache.
     result_cache_entries: int = 1024
 
+    # --- scale-out query plane (cluster/router.py) ---
+    # Any-node reads: a NON-leader node serves /leader/start through a
+    # read-only follower view of the durable placement znode (watch-
+    # refreshed) instead of refusing or falling back to the legacy
+    # sum-merge (which double-counts R-replicated documents). Requires
+    # placement persistence (placement_flush_ms >= 0); off = the
+    # pre-router behavior.
+    router_any_node_reads: bool = True
+    # Mutation-plane discipline: a non-leader node (and every
+    # dedicated router) forwards /leader/upload[-batch] and
+    # /leader/delete to the elected leader published at /leader_info —
+    # all mutations stay on the leader. Off = serve locally (legacy).
+    router_forward_writes: bool = True
+    # Periodic placement-view refresh backstop in milliseconds (the
+    # data watch on the placement znode is the primary signal; the
+    # backstop covers missed watches across coordinator failovers).
+    router_refresh_ms: float = 1000.0
+    # Honest-staleness threshold: when the follower view has not been
+    # confirmed current for this long (coordinator partition), every
+    # read response is marked degraded (X-Scatter-Degraded with
+    # stale_view=1) and the router's result cache is bypassed until
+    # the view self-heals. 0 disables the marker.
+    router_stale_ms: float = 5000.0
+    # Per-router generation-keyed result-cache entries (LRU), keyed by
+    # (membership epoch, placement view version) — every observed
+    # placement flush invalidates. 0 disables.
+    router_cache_entries: int = 1024
+
     # --- resilience (cluster plane) ---
     # Leader->worker RPC retry policy: bounded attempts with exponential
     # backoff + jitter; only transient failures (connection-level, 5xx)

@@ -62,6 +62,12 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
     "worker.fence": "worker checking a mutating RPC's X-Leader-Epoch "
                     "against its durable fence (arm to chaos-test the "
                     "fence path itself)",
+    "router.view_refresh": "a placement follower view (router / "
+                           "any-node read plane) about to re-arm its "
+                           "watch and re-read the placement znode",
+    "router.write_proxy": "a router (or non-leader node) about to "
+                          "forward a front-door mutation to the "
+                          "elected leader",
     "coord.heartbeat.*": "coordination server receiving a session "
                          "heartbeat (suffix: session id)",
     "coord.heartbeat_send": "coordination client sending a heartbeat",

@@ -84,12 +84,23 @@
 
 #   make graftcheck   project-native static analysis (tools/graftcheck):
 #                     lock-graph/deadlock, jit-purity, registry drift,
-#                     resilience coverage — against the committed
-#                     allowlist/baseline; new findings fail
+#                     resilience coverage, the wire-contract protocol
+#                     passes (endpoint/header/status/seam drift), and
+#                     the dead-symbol sweep — against the committed
+#                     allowlist/baseline; new findings fail. Use
+#                     `python -m tools.graftcheck --only protocol` for
+#                     fast iteration on one analyzer.
 #   make lockdep      the chaos/resilience/cluster suites under the
 #                     runtime lockdep witness (instrumented Lock):
 #                     fails on any inversion or any ordering the
 #                     static lock graph cannot explain
+#   make protocol-witness  the router + partition suites with the
+#                     handler classes instrumented (runtime protocol
+#                     witness): every observed (endpoint, method,
+#                     status, headers) exchange must be explained by
+#                     the static wire contract, and the core
+#                     scatter/mutation surface must actually be
+#                     exercised — lockdep-style mutual validation
 #   make check        graftcheck + tier-1 in one shot
 
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
@@ -97,7 +108,7 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition chaos-autopilot chaos-router \
         faults bench bench-overload bench-routers probe-overlap \
-        graftcheck lockdep check trace-demo
+        graftcheck lockdep protocol-witness check trace-demo
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -119,6 +130,17 @@ lockdep:
 	  tests/test_admission.py tests/test_partition.py \
 	  tests/test_observability.py tests/test_autopilot.py \
 	  tests/test_router.py \
+	  tests/test_graftcheck.py \
+	  $(PYTEST_FLAGS) -m 'not slow'
+
+# Suite choice: test_router drives the stateless-router tier (reads,
+# proxied writes, sheds, downloads) and test_partition drives the
+# fence/nemesis wire surface — together they exercise the core
+# scatter/mutation contract rows (CORE_EXERCISED in
+# tools/graftcheck/protocol_witness.py) the witness requires.
+protocol-witness:
+	JAX_PLATFORMS=cpu GRAFTCHECK_PROTOCOL=1 python -m pytest \
+	  tests/test_router.py tests/test_partition.py \
 	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 

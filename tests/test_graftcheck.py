@@ -21,17 +21,21 @@ fixed: the registry no longer holds its lock across coordination RPCs,
 and the batcher's waits are bounded with shutdown checks.
 """
 
+import json
 import os
 import threading
 import time
+import urllib.error
+import urllib.request
 
 import pytest
 
 from tools.graftcheck import core as gc_core
-from tools.graftcheck import (jitpurity, lockgraph, registry_drift,
-                              resilience, wallclock)
+from tools.graftcheck import (deadsymbols, jitpurity, lockgraph, protocol,
+                              registry_drift, resilience, wallclock)
 from tools.graftcheck.core import (SourceTree, load_allowlist,
                                    load_baseline, run_analyzers, triage)
+from tools.graftcheck.protocol_witness import ProtocolWitness
 from tools.graftcheck.witness import LockdepWitness, _InstrLock
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -642,3 +646,484 @@ class TestBatcherShutdownRegression:
         c.stop()
         with pytest.raises(RuntimeError):
             c.submit("b")
+
+
+# ---------------------------------------------------------------------------
+# 4. the wire-contract analyzer family (protocol) — seeded violations
+# ---------------------------------------------------------------------------
+
+class TestProtocolSeeded:
+    def test_detects_endpoint_drift_both_ways(self, tmp_path):
+        """Served-but-never-called AND called-but-never-served."""
+        tree = _mini_tree(tmp_path, {"cluster/h.py": '''
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_POST(self):
+        u = urllib.parse.urlparse(self.path)
+        if u.path == "/worker/served":
+            pass
+''', "cluster/c.py": '''
+def call(post, w):
+    post(w + "/worker/phantom")
+    post(w + "/worker/served")
+
+def orphan(post, w):
+    post(w + "/worker/ghost")
+'''})
+        keys = {f.key for f in protocol.check_endpoints(tree)}
+        assert "protocol:endpoint:unserved:/worker/phantom" in keys
+        assert "protocol:endpoint:unserved:/worker/ghost" in keys
+        assert not any("/worker/served" in k for k in keys), keys
+
+    def test_detects_uncalled_endpoint(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"cluster/h.py": '''
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        u = urllib.parse.urlparse(self.path)
+        if u.path == "/api/nobody-calls-me":
+            pass
+'''})
+        keys = {f.key for f in protocol.check_endpoints(tree)}
+        assert "protocol:endpoint:uncalled:/api/nobody-calls-me" in keys
+
+    def test_detects_missing_fence_stamp(self, tmp_path):
+        """A mutating worker RPC without the epoch stamp is exactly the
+        deposed-leader write the fence exists to reject."""
+        tree = _mini_tree(tmp_path, {"cluster/rpc.py": '''
+class Leader:
+    def good(self, w, http_post):
+        http_post(w + "/worker/delete", b"{}",
+                  headers=self._epoch_headers())
+
+    def bad(self, w, http_post):
+        http_post(w + "/worker/delete", b"{}",
+                  headers={"Content-Type": "application/json"})
+
+    def bad_upload(self, w, http_post):
+        http_post(w + "/worker/upload?name=a", b"data")
+'''})
+        keys = {f.key for f in protocol.check_fence_stamps(tree)}
+        assert ("protocol:header:unfenced-mutation:cluster.rpc.bad:"
+                "/worker/delete") in keys
+        assert ("protocol:header:unfenced-mutation:cluster.rpc."
+                "bad_upload:/worker/upload") in keys
+        assert not any(":cluster.rpc.good:" in k for k in keys), keys
+
+    def test_detects_missing_deadline_stamp(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"cluster/rpc.py": '''
+class Plane:
+    def ok(self, w, body, remaining):
+        return self._scatter.post(
+            w, "/worker/process-batch", body,
+            headers={"X-Deadline-Ms": str(remaining)})
+
+    def undeadlined(self, w, body):
+        return self._scatter.post(w, "/worker/process-batch", body)
+'''})
+        keys = {f.key for f in protocol.check_deadline_stamps(tree)}
+        assert ("protocol:header:undeadlined-scatter:"
+                "cluster.rpc.undeadlined") in keys
+        assert not any("cluster.rpc.ok" in k for k in keys), keys
+
+    def test_detects_unstamped_429_and_bypass_send(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"cluster/h.py": '''
+from http.server import BaseHTTPRequestHandler
+
+class _HttpHandlerBase(BaseHTTPRequestHandler):
+    def _send(self, code, body, headers=None):
+        self.send_response(code)
+        self.send_header("X-Trace-Id", "tid")
+
+class H(_HttpHandlerBase):
+    def do_POST(self):
+        self._send(429, b"overloaded")
+
+    def naked(self):
+        self.send_response(200)
+'''})
+        shed = {f.key for f in protocol.check_shed_headers(tree)}
+        assert ("protocol:header:shed-missing-retry-after:"
+                "cluster.h.H.do_POST:429") in shed
+        disc = {f.key for f in protocol.check_send_discipline(tree)}
+        assert "protocol:header:bypass-send:cluster.h.H.naked" in disc
+        # _send itself stamps the trace header and is never flagged
+        assert not any("_send" in k and "bypass" in k for k in disc)
+
+    def test_stamped_429_passes(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"cluster/h.py": '''
+from http.server import BaseHTTPRequestHandler
+
+class _HttpHandlerBase(BaseHTTPRequestHandler):
+    def _send(self, code, body, headers=None):
+        self.send_response(code)
+        self.send_header("X-Trace-Id", "tid")
+
+class H(_HttpHandlerBase):
+    def do_POST(self):
+        self._send(429, b"overloaded",
+                   headers={"Retry-After": "1", "X-Shed-Reason": "x"})
+'''})
+        assert not protocol.check_shed_headers(tree)
+
+    def test_detects_unclassified_status(self, tmp_path):
+        """A status code the README wire table never reviewed fails —
+        and a 4xx smuggled into _TRANSIENT_STATUSES (it would be
+        silently retried) fails too."""
+        (tmp_path / "README.md").write_text(
+            "## Wire contract\n\n"
+            "| endpoint | methods | lane | headers | statuses |\n"
+            "|---|---|---|---|---|\n"
+            "| `/worker/x` | POST | — | — | 200, 410 |\n")
+        tree = _mini_tree(tmp_path, {
+            "cluster/resilience.py":
+                "_TRANSIENT_STATUSES = frozenset({404, 503})\n"
+                "_SHED_STATUS = 429\n_FENCE_STATUS = 403\n",
+            "cluster/fencing.py": "FENCE_STATUS = 403\n",
+            "cluster/h.py": '''
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def _send(self, code, body):
+        self.send_response(code)
+
+    def do_POST(self):
+        self._send(200, b"ok")
+        self._send(507, b"weird")
+'''})
+        keys = {f.key for f in protocol.check_statuses(tree,
+                                                       str(tmp_path))}
+        assert "protocol:status:unknown:507" in keys
+        assert "protocol:status:transient-4xx:404" in keys
+        assert "protocol:status:readme-stale:410" in keys
+        assert not any(":200" in k for k in keys), keys
+
+    def test_detects_fence_status_mismatch(self, tmp_path):
+        (tmp_path / "README.md").write_text(
+            "## Wire contract\n\n| e | m | l | h | s |\n|---|---|---|"
+            "---|---|\n| `/worker/x` | POST | — | — | 200 |\n")
+        tree = _mini_tree(tmp_path, {
+            "cluster/resilience.py":
+                "_TRANSIENT_STATUSES = frozenset({503})\n"
+                "_FENCE_STATUS = 403\n",
+            "cluster/fencing.py": "FENCE_STATUS = 409\n",
+            "cluster/h.py": '''
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_POST(self):
+        self._send(200, b"ok")
+
+    def _send(self, code, body):
+        self.send_response(code)
+'''})
+        keys = {f.key for f in protocol.check_statuses(tree,
+                                                       str(tmp_path))}
+        assert "protocol:status:fence-mismatch" in keys
+
+    def test_detects_raw_transport_bypass(self, tmp_path):
+        """A raw transport outside the nemesis+trace seams is the
+        'same shared seams' invariant breaking."""
+        tree = _mini_tree(tmp_path, {"cluster/t.py": '''
+import urllib.request
+
+def naked(url):
+    return urllib.request.urlopen(url)
+
+def seam(url, origin):
+    global_nemesis.check_send(origin, url)
+    req = urllib.request.Request(url, headers=propagation_headers())
+    return urllib.request.urlopen(req)
+'''})
+        keys = {f.key for f in protocol.check_seams(tree)}
+        assert "protocol:seam:no-nemesis:cluster.t.naked" in keys
+        assert "protocol:seam:no-trace:cluster.t.naked" in keys
+        assert not any("cluster.t.seam" in k for k in keys), keys
+
+    def test_detects_dead_symbol(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"m.py": '''
+def used():
+    return 1
+
+def dead_helper():
+    return 2
+
+class C:
+    def dead_method(self):
+        pass
+
+    def live_method(self):
+        return used()
+
+entry = used
+
+def driver(c: C):
+    return c.live_method()
+'''})
+        keys = {f.key for f in deadsymbols.analyze(tree, str(tmp_path))}
+        assert "deadsymbols:unreferenced:m.dead_helper" in keys
+        assert "deadsymbols:unreferenced:m.C.dead_method" in keys
+        assert not any("live_method" in k or ":m.used" in k
+                       for k in keys), keys
+
+
+# ---------------------------------------------------------------------------
+# 5. protocol — the real tree
+# ---------------------------------------------------------------------------
+
+class TestProtocolRealTree:
+    @pytest.fixture(scope="class")
+    def tree(self):
+        return SourceTree(REPO_ROOT)
+
+    def test_route_extraction_floor(self, tree):
+        """The clean verdict only means something if the extraction
+        still sees the real surface — pin a floor (jit_roots
+        precedent)."""
+        routes = protocol.served_routes(tree)
+        exact = {r.path for r in routes if not r.prefix}
+        assert len(exact) >= 25, sorted(exact)
+        assert {"/leader/start", "/worker/process-batch",
+                "/worker/upload", "/rpc", "/events"} <= exact
+        assert "/api/trace/" in {r.path for r in routes if r.prefix}
+
+    def test_header_site_floors(self, tree):
+        """Zero fence/deadline findings must mean 'every site is
+        stamped', not 'extraction went stale'."""
+        assert len(protocol.mutating_rpc_sites(tree)) >= 6
+        assert len(protocol.scatter_rpc_sites(tree)) >= 3
+
+    def test_status_contract_pinned(self, tree):
+        c = protocol.build_contract(REPO_ROOT, tree)
+        assert c.statuses == {200, 400, 403, 404, 409, 415, 421, 429,
+                              500, 503, 504}
+
+    def test_protocol_clean_on_real_tree(self, tree):
+        allow = load_allowlist()
+        found = [f for f in protocol.analyze(tree, REPO_ROOT)
+                 if f.key not in allow]
+        assert not found, [f.render() for f in found]
+
+    def test_dead_symbols_clean_on_real_tree(self, tree):
+        allow = load_allowlist()
+        found = [f for f in deadsymbols.analyze(tree, REPO_ROOT)
+                 if f.key not in allow]
+        assert not found, [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
+# 6. the runtime protocol witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wire_contract():
+    return protocol.build_contract(REPO_ROOT)
+
+
+class TestProtocolWitnessSeeded:
+    def test_unexplained_exchange_fails(self, wire_contract):
+        w = ProtocolWitness(contract=wire_contract)
+        w.observe("front", "POST", "/worker/zap", 200)
+        with pytest.raises(AssertionError, match="not explained"):
+            w.check()
+
+    def test_unknown_path_404_is_contractual(self, wire_contract):
+        """404 IS the contract's answer for an unknown path."""
+        w = ProtocolWitness(contract=wire_contract)
+        w.observe("front", "GET", "/worker/zap", 404)
+        w.check()
+
+    def test_unreviewed_status_fails(self, wire_contract):
+        w = ProtocolWitness(contract=wire_contract)
+        w.observe("front", "POST", "/worker/process-batch", 507)
+        with pytest.raises(AssertionError, match="reviewed"):
+            w.check()
+
+    def test_shed_without_retry_after_fails(self, wire_contract):
+        w = ProtocolWitness(contract=wire_contract)
+        w.observe("front", "POST", "/leader/start", 429,
+                  ["X-Shed-Reason", "Connection"])
+        with pytest.raises(AssertionError, match="Retry-After"):
+            w.check()
+
+    def test_read_without_route_stamp_fails(self, wire_contract):
+        """The PR 11 catch (cache hits losing their route stamp),
+        enforced at runtime."""
+        w = ProtocolWitness(contract=wire_contract)
+        w.observe("front", "POST", "/leader/start", 200,
+                  ["X-Trace-Id"])
+        with pytest.raises(AssertionError, match="route stamp"):
+            w.check()
+
+    def test_traced_worker_reply_must_echo_trace(self, wire_contract):
+        w = ProtocolWitness(contract=wire_contract)
+        w.observe("front", "POST", "/worker/process-batch", 200,
+                  [], traced_request=True)
+        with pytest.raises(AssertionError, match="lost X-Trace-Id"):
+            w.check()
+
+    def test_unexercised_contract_fails(self, wire_contract):
+        """Lockdep-style mutual validation: statically-claimed surface
+        the run never exercised fails the witness."""
+        w = ProtocolWitness(contract=wire_contract)
+        w.observe("front", "POST", "/leader/start", 200,
+                  ["X-Trace-Id", "X-Route-Generation", "X-Route-Epoch"])
+        w.check(require_exercised={"/leader/start"})
+        with pytest.raises(AssertionError, match="never exercised"):
+            w.check(require_exercised={"/leader/start",
+                                       "/worker/process-batch"})
+
+    def test_vacuous_run_fails(self, wire_contract):
+        w = ProtocolWitness(contract=wire_contract)
+        with pytest.raises(AssertionError, match="not seeing"):
+            w.check(min_exchanges=1)
+
+
+class TestProtocolWitnessLive:
+    def test_real_node_exchanges_explained_and_traced(self, tmp_path,
+                                                      wire_contract):
+        """Acceptance: the witness observes a REAL node's exchanges and
+        explains every one — and the traced worker reply carries
+        X-Trace-Id (the fix the protocol passes surfaced: worker-plane
+        replies used to be emitted after the propagated span closed,
+        so a leader-traced scatter's answer was never stamped)."""
+        from tests.test_cluster import wait_until
+        from tfidf_tpu.cluster.coordination import (CoordinationCore,
+                                                    LocalCoordination)
+        from tfidf_tpu.cluster.node import SearchNode
+        from tfidf_tpu.utils.config import Config
+
+        cfg = Config(documents_path=str(tmp_path / "documents"),
+                     index_path=str(tmp_path / "index"), port=0,
+                     min_doc_capacity=64, min_nnz_capacity=1 << 12,
+                     min_vocab_capacity=1 << 10, query_batch=4,
+                     max_query_terms=8)
+        core = CoordinationCore(session_timeout_s=1.0)
+        w = ProtocolWitness(contract=wire_contract)
+        with w:
+            node = SearchNode(cfg,
+                              coord=LocalCoordination(core, 0.1)).start()
+            try:
+                wait_until(lambda: node.is_leader(), timeout=5.0)
+                r = urllib.request.urlopen(urllib.request.Request(
+                    node.url + "/worker/upload?name=d.txt",
+                    data=b"shared token body",
+                    headers={"Content-Type":
+                             "application/octet-stream"}))
+                assert r.status == 200
+                # front-door read: route stamp + trace id on the reply
+                r = urllib.request.urlopen(urllib.request.Request(
+                    node.url + "/leader/start", data=b"token",
+                    headers={"Content-Type": "text/plain"}))
+                assert r.status == 200
+                assert r.headers.get("X-Route-Generation") is not None
+                assert r.headers.get("X-Trace-Id")
+                # leader-traced worker RPC: the reply must echo the
+                # propagated trace id (emitted INSIDE the worker span)
+                req = urllib.request.Request(
+                    node.url + "/worker/process-batch",
+                    data=json.dumps({"queries": ["token"],
+                                     "k": 3}).encode(),
+                    headers={"Content-Type": "application/json",
+                             "X-Trace-Id": "deadbeefdeadbeef",
+                             "X-Span-Id": "cafe0123"})
+                r = urllib.request.urlopen(req)
+                assert r.status == 200
+                assert r.headers.get("X-Trace-Id") \
+                    == "deadbeefdeadbeef", dict(r.headers)
+            finally:
+                node.stop()
+                core.close()
+        rep = w.check(require_exercised={"/leader/start",
+                                         "/worker/process-batch"},
+                      min_exchanges=3)
+        assert any("/worker/process-batch" in k and "(traced)" in k
+                   for k in rep["exchanges"]), rep
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the real findings the protocol passes surfaced
+# ---------------------------------------------------------------------------
+
+class TestProtocolRegressions:
+    def test_coordination_ops_served_on_rpc_only(self):
+        """Endpoint-drift fix: the coordination server used to dispatch
+        the op switch on ANY posted path (the /rpc the client calls was
+        called-but-never-served); unknown paths must 404 now."""
+        from tfidf_tpu.cluster.coordination import CoordinationServer
+
+        srv = CoordinationServer(port=0).start()
+        try:
+            body = json.dumps({"op": "new_session"}).encode()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://{srv.address}/definitely-not-rpc",
+                    data=body,
+                    headers={"Content-Type": "application/json"}))
+            assert ei.value.code == 404
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://{srv.address}/rpc", data=body,
+                headers={"Content-Type": "application/json"}))
+            assert json.loads(r.read())["session"] > 0
+        finally:
+            srv.close()
+
+    def test_download_probe_behind_nemesis_seam(self):
+        """Seam-coverage fix: the download probes used to call urlopen
+        raw — a scripted partition could never cut the download path.
+        http_get_stream must honor an armed drop rule."""
+        from tfidf_tpu.cluster.nemesis import (NemesisPartitioned,
+                                               global_nemesis)
+        from tfidf_tpu.cluster.node import http_get_stream
+
+        global_nemesis.drop(src="http://leader:1",
+                            dst="http://worker:2")
+        try:
+            with pytest.raises(NemesisPartitioned):
+                http_get_stream(
+                    "http://worker:2/worker/download?path=x",
+                    origin="http://leader:1")
+        finally:
+            global_nemesis.heal()
+
+    def test_download_probe_propagates_trace(self, tmp_path):
+        """Seam-coverage fix, trace half: a download probe dispatched
+        inside an active span must carry X-Trace-Id (the probe hop
+        used to drop out of the request story)."""
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from tfidf_tpu.cluster.node import http_get_stream
+        from tfidf_tpu.utils.tracing import global_tracer
+
+        seen = {}
+
+        class Probe(BaseHTTPRequestHandler):
+            def do_GET(self):
+                seen["trace"] = self.headers.get("X-Trace-Id")
+                body = b"doc"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), Probe)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = (f"http://127.0.0.1:{srv.server_address[1]}"
+                   f"/worker/download?path=x")
+            with global_tracer.span("leader.download") as sp:
+                resp = http_get_stream(url, timeout=5.0)
+                assert resp.read() == b"doc"
+                resp.close()
+            assert seen["trace"] == sp.trace_id
+        finally:
+            srv.shutdown()
+            srv.server_close()

@@ -664,6 +664,16 @@ class _CoordHandler(BaseHTTPRequestHandler):
         if u.path.startswith("/ensemble/"):
             self._ensemble_rpc(u.path, req)
             return
+        if u.path != "/rpc":
+            # the wire contract: client ops ride POST /rpc only. The
+            # dispatch used to fall through to the op switch on ANY
+            # path (graftcheck protocol endpoint-drift finding: /rpc
+            # was called-but-never-served) — an unknown path must be
+            # a loud 404, not a silently-served alias. The body is
+            # already read above, so the keep-alive stream stays in
+            # sync across the rejection.
+            self._reply({"error": "not found"}, 404)
+            return
         op = req.get("op")
         sid = req.get("session", 0)
         if not self._gate_leader():

@@ -229,6 +229,24 @@ def http_post(url: str, data: bytes, content_type: str = "application/json",
         return global_nemesis.filter_reply(origin, url, r.read())
 
 
+def http_get_stream(url: str, timeout: float = 30.0,
+                    origin: str | None = None):
+    """Streaming GET through the shared seams: nemesis-instrumented and
+    trace-propagating like :func:`http_get`, but returns the OPEN
+    response object for chunked copying (the download probes) instead
+    of buffering the body. Reply-corruption nemesis rules do not apply
+    to streams — the seam contract here is send-side (partitions,
+    latency), which is what the download-path chaos needs.
+
+    (graftcheck protocol finding, fixed: the leader's and router's
+    ``/worker/download`` probes previously called ``urlopen`` raw, so
+    a scripted partition could never cut the download path and the
+    probe hop dropped out of the request trace.)"""
+    global_nemesis.check_send(origin, url)
+    req = urllib.request.Request(url, headers=propagation_headers())
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
 class WorkerDeadline(RuntimeError):
     """The caller's propagated scatter budget (``X-Deadline-Ms``) ran
     out before scoring began — the worker refuses to start, the handler
@@ -2138,8 +2156,9 @@ class SearchNode(ScatterReadPlane):
                 # app-level answer from a healthy worker — it does not
                 # count against the breaker.
                 resp = self.resilience.worker_call(
-                    w, lambda w=w: urllib.request.urlopen(
-                        w + f"/worker/download?path={q}", timeout=30.0),
+                    w, lambda w=w: http_get_stream(
+                        w + f"/worker/download?path={q}", timeout=30.0,
+                        origin=self.url),
                     retry=False)
                 size = resp.headers.get("Content-Length")
                 return resp, (int(size) if size is not None else None)
@@ -2361,16 +2380,22 @@ class _NodeHandler(_HttpHandlerBase):
                     return
                 global_injector.check("worker.process")
                 query = self._read_query()
-                try:
-                    with self._worker_span("worker.process"):
+                # the reply is emitted INSIDE the propagated span so a
+                # leader-traced request's answer carries X-Trace-Id
+                # (graftcheck protocol finding, fixed: replies sent
+                # after the `with` closed were never trace-stamped —
+                # the runtime protocol witness pins this)
+                with self._worker_span("worker.process"):
+                    try:
                         hits = node.worker_search(query)
-                except Exception as e:
-                    # reference returns [] on any failure (Worker.java:183)
-                    log.warning("search failed", err=repr(e))
-                    hits = []
-                # queries_served is counted once, by Searcher.search
-                self._json([{"document": {"name": h.name}, "score": h.score}
-                            for h in hits])
+                    except Exception as e:
+                        # reference returns [] on any failure
+                        # (Worker.java:183)
+                        log.warning("search failed", err=repr(e))
+                        hits = []
+                    # queries_served is counted once, by Searcher.search
+                    self._json([{"document": {"name": h.name},
+                                 "score": h.score} for h in hits])
             elif u.path == "/worker/process-batch":
                 # batched scatter RPC (leader-internal; packed reply —
                 # see cluster/wire.py). The per-query endpoint above
@@ -2393,15 +2418,22 @@ class _NodeHandler(_HttpHandlerBase):
                 queries = [str(q) for q in req.get("queries", ())]
                 k = req.get("k")
                 names = req.get("names")
-                try:
-                    # continues the leader's scatter trace (propagated
-                    # headers); the engine's trace_phase events and the
-                    # pipeline stage events land inside this span
-                    with self._worker_span(
-                            "worker.process_batch",
-                            queries=len(queries),
-                            slice=len(names) if names is not None
-                            else 0):
+                # continues the leader's scatter trace (propagated
+                # headers); the engine's trace_phase events and the
+                # pipeline stage events land inside this span — and so
+                # do the REPLIES (200, 500, and the 504 deadline
+                # refusal): _send stamps X-Trace-Id from the active
+                # span, so the reply the leader logs on a failed
+                # scatter leg joins the trace (graftcheck protocol
+                # finding, fixed — replies used to be emitted after
+                # the span closed and were never stamped; the runtime
+                # protocol witness pins this)
+                with self._worker_span(
+                        "worker.process_batch",
+                        queries=len(queries),
+                        slice=len(names) if names is not None
+                        else 0):
+                    try:
                         if names is not None:
                             body = pack_hit_lists(
                                 node.worker_search_slice(
@@ -2412,24 +2444,29 @@ class _NodeHandler(_HttpHandlerBase):
                                 queries,
                                 k=int(k) if k is not None else None,
                                 deadline=deadline)
-                except WorkerDeadline as e:
-                    self._send(504, f"{e}".encode(),
-                               "text/plain; charset=utf-8",
-                               headers={"X-Deadline-Exceeded": "1"})
-                    return
-                except Exception as e:
-                    # honest failure propagation (ADVICE r5): an engine
-                    # failure must surface as a 5xx the leader counts in
-                    # scatter_failures — NOT as an HTTP 200 all-empty
-                    # reply it would merge as a valid zero-hit result.
-                    # (The per-query /worker/process endpoint above keeps
-                    # the reference's []-on-failure parity shape,
-                    # Worker.java:183; this endpoint is leader-internal.)
-                    global_metrics.inc("worker_batch_failures")
-                    log.warning("batch search failed", err=repr(e))
-                    self._text(f"batch search failed: {e!r}", 500)
-                    return
-                self._send(200, body, "application/octet-stream")
+                    except WorkerDeadline as e:
+                        span_event("worker_deadline_refused")
+                        self._send(504, f"{e}".encode(),
+                                   "text/plain; charset=utf-8",
+                                   headers={"X-Deadline-Exceeded": "1"})
+                        return
+                    except Exception as e:
+                        # honest failure propagation (ADVICE r5): an
+                        # engine failure must surface as a 5xx the
+                        # leader counts in scatter_failures — NOT as an
+                        # HTTP 200 all-empty reply it would merge as a
+                        # valid zero-hit result. (The per-query
+                        # /worker/process endpoint above keeps the
+                        # reference's []-on-failure parity shape,
+                        # Worker.java:183; this endpoint is
+                        # leader-internal.)
+                        global_metrics.inc("worker_batch_failures")
+                        span_event("worker_batch_failed",
+                                   err=repr(e)[:120])
+                        log.warning("batch search failed", err=repr(e))
+                        self._text(f"batch search failed: {e!r}", 500)
+                        return
+                    self._send(200, body, "application/octet-stream")
             elif u.path == "/worker/upload":
                 name, data = self._read_upload(u)
                 if self._fence_check():   # after the body read: the

@@ -936,13 +936,19 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
 
     def _past_deadline(self) -> bool:
         """Refuse (504 + ``X-Deadline-Exceeded``) when the propagated
-        budget is already spent; True when the reply was sent."""
+        budget is already spent; True when the reply was sent. The
+        refusal is emitted inside a worker span when the caller sent a
+        trace context, so even a pre-dispatch 504 carries X-Trace-Id
+        and the refusal shows up in the leader's request story (the
+        protocol witness pins traced-reply stamping on the worker
+        plane)."""
         d = self._deadline_header()
         if d is not None and time.monotonic() > d:
             global_metrics.inc("worker_deadline_refusals")
-            self._send(504, b"deadline exceeded",
-                       "text/plain; charset=utf-8",
-                       headers={"X-Deadline-Exceeded": "1"})
+            with self._worker_span("worker.deadline_refusal"):
+                self._send(504, b"deadline exceeded",
+                           "text/plain; charset=utf-8",
+                           headers={"X-Deadline-Exceeded": "1"})
             return True
         return False
 
@@ -1523,7 +1529,9 @@ class QueryRouter(ScatterReadPlane):
         then fall back to the leader (whose own disk/store holds
         leader-local documents). Returns ``(fileobj, size|None)`` or
         None; the caller owns closing the stream."""
-        import urllib.request
+        # the shared streaming seam (nemesis + trace propagation);
+        # lazy import — node.py imports this module at load time
+        from tfidf_tpu.cluster.node import http_get_stream
 
         q = urllib.parse.quote(rel)
         targets = list(self.registry.get_all_service_addresses())
@@ -1539,9 +1547,9 @@ class QueryRouter(ScatterReadPlane):
                 # this loop's retry. A 404 (doc lives elsewhere) is an
                 # app-level answer from a healthy peer.
                 resp = self.resilience.worker_call(
-                    base, lambda base=base, route=route:
-                    urllib.request.urlopen(
-                        base + route + q, timeout=30.0),
+                    base, lambda base=base, route=route: http_get_stream(
+                        base + route + q, timeout=30.0,
+                        origin=self.url),
                     retry=False)
                 size = resp.headers.get("Content-Length")
                 return resp, (int(size) if size is not None else None)

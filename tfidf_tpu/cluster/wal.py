@@ -249,5 +249,3 @@ class DurableStore:
             pass
 
 
-def load_snapshot_state(snapshot: dict | None) -> dict | None:
-    return snapshot["state"] if snapshot else None

@@ -254,9 +254,6 @@ class ShardIndex:
         return int(sum(d.term_ids.nbytes + d.tfs.nbytes
                        for d in self._docs if d.live))
 
-    def doc_name(self, local_id: int) -> str:
-        assert self.snapshot is not None
-        return self.snapshot.doc_names[local_id]
 
     # ---- commit (publish an immutable snapshot) ----
 

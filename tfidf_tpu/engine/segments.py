@@ -96,7 +96,7 @@ class Segment:
 class _PaddedNameResolver:
     """gid -> name over the concatenated padded segment spaces — the
     ONE implementation of padded-id resolution (``name_of`` delegates
-    here too, so search-hit assembly and ``doc_name`` cannot drift)."""
+    here too, so search-hit assembly cannot drift from it)."""
 
     __slots__ = ("_segments", "_bases")
 
@@ -918,8 +918,3 @@ class SegmentedIndex:
                          else deadline - time.monotonic())
             fut.result(timeout=remaining)
 
-    def doc_name(self, gid: int) -> str:
-        assert self.snapshot is not None
-        name = self.snapshot.name_of(int(gid))
-        assert name is not None, gid
-        return name

@@ -779,6 +779,3 @@ def extract_text(data: bytes) -> str:
     return text
 
 
-def extract_file(path: str) -> str:
-    with open(path, "rb") as f:
-        return extract_text(f.read())

@@ -68,11 +68,6 @@ class CooShard:
     def vocab_cap(self) -> int:
         return self.df.shape[0]
 
-    @property
-    def total_terms(self) -> float:
-        """Sum of doc lengths — numerator of avgdl (Lucene sumTotalTermFreq)."""
-        return float(np.asarray(self.doc_len).sum())
-
     def size_bytes(self) -> int:
         """The load metric — analog of GET /worker/index-size
         (reference ``Worker.java:147-172``), used for least-loaded placement."""

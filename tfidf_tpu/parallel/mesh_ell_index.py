@@ -454,11 +454,6 @@ class MeshEllIndex(MeshIndex):
                                           np.float32, n)
         return mask
 
-    def doc_name(self, gid: int) -> str:
-        assert self.snapshot is not None
-        name = self.snapshot.name_of(int(gid))
-        assert name is not None, gid
-        return name
 
 
 class MeshEllSearcher(MeshSearcher):

@@ -207,11 +207,6 @@ class MeshIndex:
             out.extend(self._pending.values())
             return out
 
-    def doc_name(self, gid: int) -> str:
-        assert self.snapshot is not None
-        name = self.snapshot.name_of(int(gid))
-        assert name is not None, gid
-        return name
 
     # ---- commit ----
 

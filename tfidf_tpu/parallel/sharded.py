@@ -69,9 +69,6 @@ class ShardedArrays:
     doc_cap: int
     vocab_cap: int
 
-    @property
-    def shape_dt(self) -> tuple[int, int]:
-        return self.tf.shape[0], self.tf.shape[1]
 
 
 jax.tree_util.register_dataclass(

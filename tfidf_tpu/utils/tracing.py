@@ -491,16 +491,3 @@ def phase_timings() -> dict[str, float]:
     """Snapshot of per-phase timing stats (phase_* keys only)."""
     return {k: v for k, v in global_metrics.snapshot().items()
             if k.startswith("phase_")}
-
-
-@contextlib.contextmanager
-def profile_to(logdir: str) -> Iterator[None]:
-    """Capture a full XLA/TPU profiler trace into ``logdir``."""
-    if _jprof is None:  # pragma: no cover
-        yield
-        return
-    _jprof.start_trace(logdir)
-    try:
-        yield
-    finally:
-        _jprof.stop_trace()

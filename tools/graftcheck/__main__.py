@@ -5,6 +5,9 @@ any NEW finding exists — the CI contract: the committed pins hold the
 reviewed state, and anything the analyzers newly surface fails the run.
 
 Options:
+    --only A[,B...]    analyzer subset (same as the positional list;
+                       e.g. ``--only protocol`` for fast iteration on
+                       the wire-contract passes)
     --json             machine-readable report on stdout
     --graph            also print the computed lock-order edges
     --write-baseline   rewrite baseline.json with the current findings
@@ -23,26 +26,40 @@ from tools.graftcheck.core import (BASELINE_PATH, load_allowlist,
                                    load_baseline, run_analyzers, triage)
 
 ANALYZERS = ("lockgraph", "jitpurity", "registry_drift", "resilience",
-             "wallclock")
+             "wallclock", "protocol", "deadsymbols")
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="graftcheck")
     ap.add_argument("analyzers", nargs="*", choices=[*ANALYZERS, []],
                     help="subset to run (default: all)")
+    ap.add_argument("--only", default="",
+                    help="comma-separated analyzer subset (alias of "
+                         "the positional list, e.g. --only protocol)")
     ap.add_argument("--root", default=".")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--graph", action="store_true")
     ap.add_argument("--write-baseline", action="store_true")
     args = ap.parse_args(argv)
 
-    which = list(args.analyzers) or None
+    only = [a for a in args.only.split(",") if a]
+    bad = sorted(set(only) - set(ANALYZERS))
+    if bad:
+        ap.error(f"unknown analyzer(s) in --only: {bad} "
+                 f"(choose from {', '.join(ANALYZERS)})")
+    which = (list(args.analyzers) + only) or None
     findings = run_analyzers(args.root, which)
     allowlist = load_allowlist()
     baseline = load_baseline()
     new, pinned, stale = triage(findings, allowlist, baseline)
 
     if args.write_baseline:
+        if which is not None:
+            # a subset's findings are not the whole tree's: rewriting
+            # the shared baseline from them would silently drop every
+            # other analyzer's pins
+            ap.error("--write-baseline requires the full analyzer set "
+                     "(drop the subset/--only selection)")
         keys = sorted({f.key for f in findings} - set(allowlist))
         with open(BASELINE_PATH, "w", encoding="utf-8") as f:
             json.dump(keys, f, indent=1)

@@ -540,8 +540,9 @@ def run_analyzers(root: str, analyzers: list[str] | None = None
                   ) -> list[Finding]:
     """Run the requested analyzers (default: all) over the package at
     ``root``; returns RAW findings (baseline/allowlist not applied)."""
-    from tools.graftcheck import (jitpurity, lockgraph, registry_drift,
-                                  resilience, wallclock)
+    from tools.graftcheck import (deadsymbols, jitpurity, lockgraph,
+                                  protocol, registry_drift, resilience,
+                                  wallclock)
     tree = SourceTree(root)
     passes = {
         "lockgraph": lockgraph.analyze,
@@ -549,6 +550,8 @@ def run_analyzers(root: str, analyzers: list[str] | None = None
         "registry_drift": lambda t: registry_drift.analyze(t, root),
         "resilience": resilience.analyze,
         "wallclock": wallclock.analyze,
+        "protocol": lambda t: protocol.analyze(t, root),
+        "deadsymbols": lambda t: deadsymbols.analyze(t, root),
     }
     out: list[Finding] = []
     for name, fn in passes.items():

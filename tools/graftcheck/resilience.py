@@ -32,7 +32,8 @@ import ast
 
 from tools.graftcheck.core import Finding, SourceTree, _dotted
 
-_RAW_TRANSPORTS = {"urlopen", "http_post", "http_get", "_post_json"}
+_RAW_TRANSPORTS = {"urlopen", "http_post", "http_get", "http_get_stream",
+                   "_post_json"}
 _RAW_METHODS = {"post"}         # self._scatter.post
 _WRAPPER = "worker_call"
 

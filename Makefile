@@ -48,6 +48,19 @@
 #                     read is exact single-node-oracle parity or
 #                     honestly degraded (X-Scatter-Degraded), and the
 #                     tier heals (tests/test_router.py -m slow)
+#   make chaos-powerloss  slow whole-cluster power-loss chaos job: an
+#                     upload/search workload with the DISK nemesis
+#                     armed (torn writes on the document stores) while
+#                     kill -9 hits EVERY node AND the coordinator at
+#                     once; full restart on the same dirs must show
+#                     zero acked-upload loss and exact single-node-
+#                     oracle parity on every post-restart search
+#                     (tests/test_storage.py -m slow)
+#   make scrub        offline storage-integrity verification: every
+#                     checkpoint version's manifest + the placed-docs
+#                     CRC ledger (python -m tfidf_tpu scrub; exit 1 on
+#                     corruption). POST /admin/scrub runs the same
+#                     pass on a live node.
 #   make chaos-partition  slow jepsen-style partition chaos job: a
 #                     concurrent upsert/delete/search workload while
 #                     the network nemesis (cluster/nemesis.py) deposes
@@ -107,6 +120,7 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition chaos-autopilot chaos-router \
+        chaos-powerloss scrub \
         faults bench bench-overload bench-routers probe-overlap \
         graftcheck lockdep protocol-witness check trace-demo
 
@@ -129,7 +143,7 @@ lockdep:
 	  tests/test_replication.py tests/test_rebalance.py \
 	  tests/test_admission.py tests/test_partition.py \
 	  tests/test_observability.py tests/test_autopilot.py \
-	  tests/test_router.py \
+	  tests/test_router.py tests/test_storage.py \
 	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
@@ -172,6 +186,12 @@ chaos-autopilot:
 
 chaos-router:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py $(PYTEST_FLAGS) -m slow
+
+chaos-powerloss:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_storage.py $(PYTEST_FLAGS) -m slow
+
+scrub:
+	python -m tfidf_tpu scrub
 
 faults:
 	python -m tfidf_tpu faults list

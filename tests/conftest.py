@@ -39,9 +39,12 @@ def rng():
 def _reset_faults_and_metrics():
     from tfidf_tpu.utils.faults import global_injector
     from tfidf_tpu.utils.metrics import global_metrics
+    from tfidf_tpu.utils.storage import global_storage
     yield
     global_injector.disarm()
     global_injector.fired.clear()
+    global_storage.heal()
+    global_storage.fired.clear()
     global_metrics.reset()
 
 

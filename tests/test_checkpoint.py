@@ -64,15 +64,59 @@ def test_repeated_saves_prune_versions(tmp_path):
     e = make_engine(tmp_path)
     ingest_corpus(e)
     ckpt = str(tmp_path / "ckpt")
-    for i in range(3):
+    for i in range(4):
         e.ingest_text(f"extra{i}.txt", "more content")
         e.commit()
         save_checkpoint(e, ckpt)
     assert os.path.islink(ckpt)
     versions = [d for d in os.listdir(tmp_path) if d.startswith("ckpt.v")]
-    assert len(versions) == 1          # superseded versions pruned
+    # superseded versions pruned down to storage_keep_versions (default
+    # 2): the published one plus one intact fallback for restore
+    assert len(versions) == e.config.storage_keep_versions == 2
+    # no .build temp dirs leak past a successful publish
+    assert not [d for d in os.listdir(tmp_path)
+                if d.startswith("ckpt.build.")]
     e2 = load_checkpoint(ckpt, e.config)
-    assert e2.index.num_live_docs == len(CORPUS) + 3
+    assert e2.index.num_live_docs == len(CORPUS) + 4
+
+
+def test_crash_mid_save_never_tears_newest_version(tmp_path):
+    """Satellite regression (ISSUE 14): the version NAME only ever
+    appears via one atomic rename of a complete manifested directory —
+    a crash ANYWHERE mid-save (torn array write, fsync EIO, crash
+    before the dir rename) must never make the newest ``.v<N>`` the
+    torn one. After each simulated crash every surviving version dir
+    passes its manifest check and loads to the pre-crash state."""
+    import os
+
+    from tfidf_tpu.engine.checkpoint import (checkpoint_versions,
+                                             restore_checkpoint)
+    from tfidf_tpu.utils import storage
+
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+    good_docs = e.index.num_live_docs
+
+    crashes = [
+        ("torn docs.npz", storage.TORN_WRITE, "*docs.npz"),
+        ("fsync EIO", storage.FSYNC_EIO, "*ckpt.build*"),
+        ("crash before version rename", storage.CRASH_BEFORE_RENAME,
+         "*ckpt.v*"),
+    ]
+    for i, (label, kind, glob) in enumerate(crashes):
+        e.ingest_text(f"crash{i}.txt", "content that must not ack")
+        e.commit()
+        rid = storage.global_storage.arm(kind, glob, times=1)
+        with pytest.raises(OSError):
+            save_checkpoint(e, ckpt)
+        storage.global_storage.remove(rid)
+        for vdir in checkpoint_versions(ckpt):
+            assert storage.verify_manifest(vdir) == [], (label, vdir)
+    # the published checkpoint still restores the last GOOD state
+    e2, _meta = restore_checkpoint(ckpt, e.config)
+    assert e2.index.num_live_docs == good_docs
 
 
 def test_bulk_restore_equals_per_doc_replay(tmp_path):

@@ -1,0 +1,941 @@
+"""Storage-fault nemesis + crash-consistent durability (ISSUE 14).
+
+The contract under test, cell by cell: **no seeded storage fault ever
+produces silently wrong search results** — every corruption either
+transparently recovers to the previous good state or refuses loudly.
+
+- the durable-IO seam primitives (`utils/storage.py`): atomic publish
+  under torn writes / fsync EIO / ENOSPC / crash-around-rename, CRC
+  envelopes catching bit rot, manifests, group commit;
+- the checkpoint corruption matrix: truncated `docs.npz`, a flipped
+  byte in EACH manifest-covered file, a missing manifest — restore
+  falls back to the newest intact version (quarantining the bad one)
+  with results exactly equal to that version's, and refuses loudly
+  when no intact version exists;
+- torn / bit-rotted `fence_epoch.json` (a flipped digit is valid JSON
+  with a WRONG lower epoch — the CRC envelope must catch it);
+- WAL torn tail and snapshot bit rot × restart;
+- the ENOSPC wire contract: distinct 507, non-retryable, never a
+  breaker trip, `storage_enospc` counted;
+- fsync-before-ack with group commit on the upload plane;
+- the integrity scrub: a rotten `placed_docs` copy repaired from a
+  healthy replica, an unrepairable one surfaced loudly, a corrupt
+  checkpoint version quarantined while its fallback exists.
+
+The slow job (`make chaos-powerloss`) is the acceptance criterion end
+to end: SIGKILL of EVERY node and the coordinator mid-workload under
+active disk faults, full restart on the same dirs, zero acked-upload
+loss, exact single-node-oracle parity on every post-restart search.
+"""
+
+import json
+import os
+import shutil
+import threading
+import urllib.error
+import zlib
+
+import pytest
+
+from tfidf_tpu.cluster.coordination import CoordinationCore, \
+    LocalCoordination
+from tfidf_tpu.cluster.fencing import FenceGuard
+from tfidf_tpu.cluster.node import SearchNode, http_post
+from tfidf_tpu.cluster.resilience import is_retryable, is_worker_fault
+from tfidf_tpu.cluster.wal import DurableStore
+from tfidf_tpu.engine.checkpoint import (load_checkpoint,
+                                         restore_checkpoint,
+                                         save_checkpoint)
+from tfidf_tpu.utils import storage
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.storage import (DiskFault, StorageCorruption,
+                                     global_storage)
+
+from tests.test_cluster import wait_until
+from tests.test_engine import ingest_corpus, make_engine
+
+
+# ---------------------------------------------------------------------------
+# seam primitives under the disk nemesis
+# ---------------------------------------------------------------------------
+
+class TestSeamPrimitives:
+    def test_atomic_write_roundtrip(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        storage.atomic_write_bytes(p, b"one")
+        storage.atomic_write_bytes(p, b"two")
+        assert storage.read_bytes(p) == b"two"
+        assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+    def test_torn_write_never_tears_published_file(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        storage.atomic_write_bytes(p, b"committed content")
+        global_storage.arm(storage.TORN_WRITE, f"{p}*", keep_bytes=3)
+        with pytest.raises(DiskFault):
+            storage.atomic_write_bytes(p, b"replacement that crashes")
+        global_storage.heal()
+        # the published name still holds the complete OLD content and
+        # the torn temp never leaks
+        assert storage.read_bytes(p) == b"committed content"
+        assert os.listdir(tmp_path) == ["f.txt"]
+
+    def test_fsync_eio_fails_before_publish(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        storage.atomic_write_bytes(p, b"old")
+        global_storage.arm(storage.FSYNC_EIO, f"{p}*", times=1)
+        with pytest.raises(DiskFault):
+            storage.atomic_write_bytes(p, b"new")
+        global_storage.heal()
+        assert storage.read_bytes(p) == b"old"
+
+    def test_crash_before_and_after_rename(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        storage.atomic_write_bytes(p, b"old")
+        global_storage.arm(storage.CRASH_BEFORE_RENAME, p, times=1)
+        with pytest.raises(DiskFault):
+            storage.atomic_write_bytes(p, b"new")
+        assert storage.read_bytes(p) == b"old"   # publish never happened
+        global_storage.heal()
+        global_storage.arm(storage.CRASH_AFTER_RENAME, p, times=1)
+        with pytest.raises(DiskFault):
+            storage.atomic_write_bytes(p, b"new")
+        global_storage.heal()
+        assert storage.read_bytes(p) == b"new"   # publish DID land
+
+    def test_enospc_is_counted_and_classified(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        global_storage.arm(storage.ENOSPC, f"{p}*")
+        before = global_metrics.get("storage_enospc") or 0
+        with pytest.raises(OSError) as ei:
+            storage.atomic_write_bytes(p, b"x")
+        global_storage.heal()
+        assert storage.is_enospc(ei.value)
+        assert (global_metrics.get("storage_enospc") or 0) > before
+
+    def test_json_envelope_catches_bitrot(self, tmp_path):
+        p = str(tmp_path / "state.json")
+        storage.atomic_write_json(p, {"epoch": 173})
+        assert storage.read_json(p) == {"epoch": 173}
+        global_storage.arm(storage.BITROT, p, keep_bytes=30)
+        with pytest.raises(StorageCorruption):
+            storage.read_json(p)
+        global_storage.heal()
+        # legacy (pre-envelope) files stay readable across the upgrade
+        with open(str(tmp_path / "legacy.json"), "w") as f:
+            json.dump({"epoch": 9}, f)
+        assert storage.read_json(str(tmp_path / "legacy.json")) == \
+            {"epoch": 9}
+
+    def test_env_rule_loading(self):
+        n = global_storage.load_env(
+            '[{"kind": "torn_write", "glob": "*never-matches-xyz*",'
+            ' "probability": 0.5, "times": 2, "keep_bytes": 8}]')
+        assert n == 1 and global_storage.active()
+        global_storage.heal()
+
+
+class TestManifest:
+    def _mkdir(self, tmp_path):
+        d = str(tmp_path / "v1")
+        os.makedirs(d)
+        for name, data in (("a.bin", b"alpha" * 10),
+                           ("b.json", b'{"k": 1}')):
+            storage.write_bytes(os.path.join(d, name), data)
+        storage.write_manifest(d)
+        return d
+
+    def test_intact_dir_verifies_clean(self, tmp_path):
+        assert storage.verify_manifest(self._mkdir(tmp_path)) == []
+
+    def test_flipped_byte_in_each_file_detected(self, tmp_path):
+        for victim in ("a.bin", "b.json"):
+            d = self._mkdir(tmp_path / victim.replace(".", "_"))
+            p = os.path.join(d, victim)
+            raw = bytearray(open(p, "rb").read())
+            raw[2] ^= 0x01
+            open(p, "wb").write(bytes(raw))
+            problems = storage.verify_manifest(d)
+            assert problems and victim in problems[0]
+
+    def test_truncation_and_missing_file_detected(self, tmp_path):
+        d = self._mkdir(tmp_path)
+        with open(os.path.join(d, "a.bin"), "r+b") as f:
+            f.truncate(5)
+        assert any("a.bin" in p for p in storage.verify_manifest(d))
+        os.unlink(os.path.join(d, "a.bin"))
+        assert any("missing" in p for p in storage.verify_manifest(d))
+
+    def test_missing_or_rotten_manifest_is_loud(self, tmp_path):
+        d = self._mkdir(tmp_path)
+        mp = os.path.join(d, storage.MANIFEST_NAME)
+        raw = bytearray(open(mp, "rb").read())
+        raw[len(raw) // 2] ^= 0x5A
+        open(mp, "wb").write(bytes(raw))
+        assert any("manifest" in p for p in storage.verify_manifest(d))
+        os.unlink(mp)
+        assert any("manifest missing" in p
+                   for p in storage.verify_manifest(d))
+
+
+class TestGroupCommit:
+    def test_concurrent_syncs_coalesce_and_complete(self, tmp_path):
+        gc = storage.GroupCommitter()
+        paths = []
+        for i in range(24):
+            p = str(tmp_path / f"f{i}")
+            storage.write_bytes(p, b"x" * 64)
+            paths.append(p)
+        errs = []
+        gate = threading.Event()
+
+        def worker(p):
+            gate.wait()
+            try:
+                gc.sync([p, str(tmp_path)])
+            except Exception as e:   # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in paths]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        # coalescing happened: far fewer flush rounds than callers is
+        # timing-dependent, but EVERY caller was serviced by SOME round
+        assert (global_metrics.get("storage_group_commit_items") or 0) \
+            >= 24
+
+    def test_fsync_failure_reaches_only_the_right_caller(self, tmp_path):
+        gc = storage.GroupCommitter()
+        good = str(tmp_path / "good")
+        bad = str(tmp_path / "bad")
+        storage.write_bytes(good, b"g")
+        storage.write_bytes(bad, b"b")
+        global_storage.arm(storage.FSYNC_EIO, bad)
+        results = {}
+
+        def run(tag, p):
+            try:
+                gc.sync([p])
+                results[tag] = "ok"
+            except OSError:
+                results[tag] = "err"
+
+        ts = [threading.Thread(target=run, args=("good", good)),
+              threading.Thread(target=run, args=("bad", bad))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        global_storage.heal()
+        assert results == {"good": "ok", "bad": "err"}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint corruption matrix (recovery-or-loud-refusal, never silent)
+# ---------------------------------------------------------------------------
+
+def _results(e, queries=("fast food", "cat night", "fast")):
+    return {q: [(h.name, round(float(h.score), 5))
+                for h in e.search(q, k=10)] for q in queries}
+
+
+@pytest.fixture
+def two_version_ckpt(tmp_path):
+    """A checkpoint base with two intact versions: v1 (the fallback
+    state) and v2 (the published state, with one extra doc)."""
+    e = make_engine(tmp_path)
+    ingest_corpus(e)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(e, ckpt)
+    want_v1 = _results(e)
+    e.ingest_text("extra.txt", "fresh fast document")
+    e.commit()
+    save_checkpoint(e, ckpt)
+    want_v2 = _results(e)
+    assert want_v1 != want_v2
+    return e.config, ckpt, want_v1, want_v2
+
+
+CKPT_FILES = ("vocab.txt", "docs.npz", "names.json", "meta.json",
+              "snapshot.npz")
+
+
+class TestCheckpointCorruptionMatrix:
+    def _current(self, ckpt):
+        return os.path.join(os.path.dirname(ckpt), os.readlink(ckpt))
+
+    def _flip(self, path, offset=100):
+        raw = bytearray(open(path, "rb").read())
+        raw[offset % len(raw)] ^= 0x01
+        open(path, "wb").write(bytes(raw))
+
+    @pytest.mark.parametrize("victim", CKPT_FILES)
+    def test_flipped_byte_falls_back_to_intact_version(
+            self, two_version_ckpt, victim):
+        cfg, ckpt, want_v1, _want_v2 = two_version_ckpt
+        vdir = self._current(ckpt)
+        if not os.path.exists(os.path.join(vdir, victim)):
+            pytest.skip(f"{victim} not in this checkpoint layout")
+        self._flip(os.path.join(vdir, victim))
+        # strict load refuses loudly...
+        with pytest.raises(StorageCorruption):
+            load_checkpoint(ckpt, cfg)
+        # ...and the fallback restore recovers EXACTLY the previous
+        # good state, quarantining the corrupt version
+        e2, _meta = restore_checkpoint(ckpt, cfg)
+        assert _results(e2) == want_v1
+        assert any(".quarantine" in d
+                   for d in os.listdir(os.path.dirname(ckpt)))
+        assert (global_metrics.get("checkpoint_fallbacks") or 0) >= 1
+
+    def test_truncated_docs_npz_falls_back(self, two_version_ckpt):
+        cfg, ckpt, want_v1, _ = two_version_ckpt
+        p = os.path.join(self._current(ckpt), "docs.npz")
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        e2, _meta = restore_checkpoint(ckpt, cfg)
+        assert _results(e2) == want_v1
+
+    def test_missing_manifest_falls_back(self, two_version_ckpt):
+        cfg, ckpt, want_v1, _ = two_version_ckpt
+        os.unlink(os.path.join(self._current(ckpt),
+                               storage.MANIFEST_NAME))
+        e2, _meta = restore_checkpoint(ckpt, cfg)
+        assert _results(e2) == want_v1
+
+    def test_dangling_symlink_still_finds_fallback(self,
+                                                   two_version_ckpt):
+        """After a quarantine the published symlink dangles —
+        ``os.path.isdir(base)`` is False, but the boot gate
+        (``checkpoint_versions``) must still surface the intact
+        fallback so serve restores instead of paying a full re-walk."""
+        from tfidf_tpu.engine.checkpoint import (checkpoint_versions,
+                                                 quarantine_version)
+        cfg, ckpt, want_v1, _ = two_version_ckpt
+        quarantine_version(self._current(ckpt))
+        assert not os.path.isdir(ckpt)          # the dangling link
+        assert checkpoint_versions(ckpt)        # ...still has versions
+        e2, _meta = restore_checkpoint(ckpt, cfg)
+        assert _results(e2) == want_v1
+
+    def test_legacy_pre_manifest_checkpoint_still_loads(
+            self, two_version_ckpt):
+        """In-place upgrade path: checkpoints saved before the manifest
+        format exist with NO MANIFEST.json anywhere. They are
+        unverifiable, not corrupt — restore must last-resort load the
+        newest one (loud warning + metric) instead of quarantining
+        every valid checkpoint and forcing a full re-walk."""
+        cfg, ckpt, _v1, want_v2 = two_version_ckpt
+        parent = os.path.dirname(ckpt)
+        for d in os.listdir(parent):
+            mp = os.path.join(parent, d, storage.MANIFEST_NAME)
+            if d.startswith("ckpt.v") and os.path.isfile(mp):
+                os.unlink(mp)
+        e2, _meta = restore_checkpoint(ckpt, cfg)
+        assert _results(e2) == want_v2   # the PUBLISHED version wins
+        assert (global_metrics.get("checkpoint_legacy_loads") or 0) >= 1
+        assert not any(".quarantine" in d for d in os.listdir(parent))
+
+    def test_all_versions_corrupt_refuses_loudly(self, two_version_ckpt):
+        cfg, ckpt, _v1, _v2 = two_version_ckpt
+        parent = os.path.dirname(ckpt)
+        for d in os.listdir(parent):
+            full = os.path.join(parent, d)
+            if d.startswith("ckpt.v") and os.path.isdir(full):
+                self._flip(os.path.join(full, "docs.npz"))
+        with pytest.raises(StorageCorruption):
+            restore_checkpoint(ckpt, cfg)
+
+    def test_bitrot_on_read_back_is_caught(self, two_version_ckpt):
+        """The nemesis BITROT kind: bytes rot on the platter between
+        save and load — the manifest verification reads through the
+        seam and must see (and catch) the damage."""
+        cfg, ckpt, want_v1, _ = two_version_ckpt
+        vdir = self._current(ckpt)
+        global_storage.arm(storage.BITROT,
+                           os.path.join(vdir, "docs.npz"))
+        e2, _meta = restore_checkpoint(ckpt, cfg)
+        global_storage.heal()
+        assert _results(e2) == want_v1
+
+
+# ---------------------------------------------------------------------------
+# fence sidecar: torn / bit-rotted epoch state
+# ---------------------------------------------------------------------------
+
+class TestFenceSidecarCorruption:
+    def test_roundtrip_and_durability(self, tmp_path):
+        p = str(tmp_path / "fence_epoch.json")
+        g = FenceGuard(p)
+        assert g.observe(7)
+        g2 = FenceGuard(p)
+        assert g2.current() == 7
+        assert not g2.observe(5)   # lower epoch stays fenced
+
+    def test_torn_sidecar_starts_permissive_and_loud(self, tmp_path):
+        p = str(tmp_path / "fence_epoch.json")
+        FenceGuard(p).observe(7)
+        with open(p, "r+b") as f:   # torn write: half the file
+            f.truncate(os.path.getsize(p) // 2)
+        g = FenceGuard(p)
+        assert g.current() == -1   # fresh-worker permissive, like a
+        #                            brand-new node — never a GUESSED epoch
+        assert (global_metrics.get("fence_state_unreadable") or 0) >= 1
+
+    def test_bitrot_never_yields_a_wrong_lower_epoch(self, tmp_path):
+        """The killer case the CRC envelope exists for: a flipped digit
+        turns epoch 173 into VALID JSON saying 133 — silently accepting
+        it would let a deposed leader capture this worker."""
+        p = str(tmp_path / "fence_epoch.json")
+        FenceGuard(p).observe(173)
+        raw = open(p, "rb").read()
+        assert b"173" in raw
+        open(p, "wb").write(raw.replace(b"173", b"133", 1))
+        g = FenceGuard(p)
+        assert g.current() == -1   # refused, NOT 133
+        assert (global_metrics.get("fence_state_unreadable") or 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# WAL: torn tail / snapshot rot × restart
+# ---------------------------------------------------------------------------
+
+class TestWalCorruption:
+    def test_torn_tail_truncates_to_acked_prefix(self, tmp_path):
+        d = str(tmp_path / "wal")
+        st = DurableStore(d)
+        st.append([{"i": 1, "t": 1, "c": {"op": "a"}}])
+        st.append([{"i": 2, "t": 1, "c": {"op": "b"}}])
+        st.close()
+        wal = os.path.join(d, "wal.log")
+        with open(wal, "r+b") as f:   # tear the LAST frame mid-payload
+            f.truncate(os.path.getsize(wal) - 3)
+        st2 = DurableStore(d)
+        _meta, _snap, entries = st2.load()
+        st2.close()
+        assert [e["i"] for e in entries] == [1]   # acked prefix intact
+        assert (global_metrics.get("wal_truncated_bytes") or 0) > 0
+
+    def test_rewrite_failure_keeps_store_usable(self, tmp_path):
+        """A failed compaction rewrite (ENOSPC / armed nemesis) must
+        leave the OLD log intact and the append handle open — a
+        transient disk hiccup must not wedge the coordination node
+        until restart."""
+        d = str(tmp_path / "wal")
+        st = DurableStore(d)
+        st.append([{"i": 1, "t": 1, "c": {"op": "a"}}])
+        global_storage.arm(storage.ENOSPC, "*wal.log*", times=1)
+        with pytest.raises(OSError):
+            st.rewrite([{"i": 1, "t": 1, "c": {"op": "a"}}])
+        global_storage.heal()
+        st.append([{"i": 2, "t": 1, "c": {"op": "b"}}])
+        st.close()
+        st2 = DurableStore(d)
+        _meta, _snap, entries = st2.load()
+        st2.close()
+        assert [e["i"] for e in entries] == [1, 2]
+
+    def test_snapshot_bitrot_replays_wal_instead(self, tmp_path):
+        d = str(tmp_path / "wal")
+        st = DurableStore(d)
+        st.append([{"i": 1, "t": 1, "c": {"op": "a"}}])
+        st.write_snapshot({"tree": {}}, 1, 1)
+        st.close()
+        snap = os.path.join(d, "snapshot.json")
+        raw = bytearray(open(snap, "rb").read())
+        raw[len(raw) // 2] ^= 0x08
+        open(snap, "wb").write(bytes(raw))
+        st2 = DurableStore(d)
+        _meta, snapshot, entries = st2.load()
+        st2.close()
+        # rotten snapshot detected (CRC envelope) -> full-WAL replay,
+        # never a silently-wrong state machine
+        assert snapshot is None
+        assert [e["i"] for e in entries] == [1]
+
+
+# ---------------------------------------------------------------------------
+# cluster plane: ENOSPC wire contract, fsync-before-ack, scrub
+# ---------------------------------------------------------------------------
+
+_CFG = dict(
+    top_k=32, min_doc_capacity=64, min_nnz_capacity=1 << 12,
+    min_vocab_capacity=1 << 10, query_batch=8, max_query_terms=8,
+    rpc_max_attempts=1, breaker_failure_threshold=2,
+    reconcile_sweep_interval_s=0.2, placement_flush_ms=10.0,
+    result_cache_entries=0)
+
+DOCS = {f"st{i}.txt": f"common token{i} word{i % 3}" for i in range(8)}
+
+
+@pytest.fixture
+def core():
+    c = CoordinationCore(session_timeout_s=0.5)
+    yield c
+    c.close()
+
+
+def _node(core, tmp_path, i, **kw):
+    cfg_kw = dict(_CFG)
+    cfg_kw.update(kw)
+    cfg = Config(
+        documents_path=str(tmp_path / f"st{i}" / "documents"),
+        index_path=str(tmp_path / f"st{i}" / "index"),
+        port=0, **cfg_kw)
+    return SearchNode(cfg, coord=LocalCoordination(core, 0.1)).start()
+
+
+def _mk_cluster(core, tmp_path, n=3, **kw):
+    nodes = [_node(core, tmp_path, i, **kw) for i in range(n)]
+    wait_until(lambda: len(
+        nodes[0].registry.get_all_service_addresses()) == n - 1)
+    return nodes
+
+
+def _stop_all(nodes):
+    for nd in nodes:
+        try:
+            nd.stop()
+        except Exception:
+            pass
+
+
+def _upload(leader, docs=DOCS):
+    batch = [{"name": n, "text": t} for n, t in docs.items()]
+    return json.loads(http_post(leader.url + "/leader/upload-batch",
+                                json.dumps(batch).encode()))
+
+
+class TestEnospcContract:
+    def test_classifier_unit(self):
+        e = urllib.error.HTTPError("u", 507, "storage", {}, None)
+        assert not is_retryable(e)       # a full disk does not drain
+        assert not is_worker_fault(e)    # and must not trip breakers
+
+    def test_worker_507_and_no_breaker_trip(self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            _upload(leader)
+            # disk full on every documents dir: the next upload must be
+            # a distinct 507 end to end (worker verdict relayed by the
+            # leader), counted, and NOT a breaker trip
+            global_storage.arm(storage.ENOSPC, "*documents*")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_post(leader.url + "/leader/upload?name=full.txt",
+                          b"this write has nowhere to land")
+            assert ei.value.code == 507
+            assert (global_metrics.get("storage_enospc") or 0) >= 1
+            global_storage.heal()
+            for w in leader.registry.get_all_service_addresses():
+                assert not leader.resilience.board.is_open(w), \
+                    "breaker tripped on a full disk"
+            # the disk healed: uploads work again immediately (no
+            # breaker to wait out)
+            resp = http_post(
+                leader.url + "/leader/upload?name=after.txt",
+                b"space is back")
+            assert b"uploaded successfully" in resp
+        finally:
+            _stop_all(nodes)
+
+    def test_batch_enospc_is_507(self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=2)
+        try:
+            leader = nodes[0]
+            global_storage.arm(storage.ENOSPC, "*documents*")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_post(
+                    nodes[1].url + "/worker/upload-batch",
+                    json.dumps([{"name": "x.txt", "text": "y"}]).encode())
+            assert ei.value.code == 507
+            # ...and the LEADER front door relays the batch verdict as
+            # 507 too (every replica leg full), never a retryable 500
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_post(
+                    leader.url + "/leader/upload-batch",
+                    json.dumps([{"name": "z.txt", "text": "w"}]).encode())
+            assert ei.value.code == 507
+            for w in leader.registry.get_all_service_addresses():
+                assert not leader.resilience.board.is_open(w)
+        finally:
+            global_storage.heal()
+            _stop_all(nodes)
+
+
+class TestFsyncBeforeAck:
+    def test_acked_upload_is_fsynced_and_group_committed(
+            self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=2)
+        try:
+            leader = nodes[0]
+            before = global_metrics.get("storage_fsyncs") or 0
+            resp = _upload(leader)
+            assert not resp.get("failed")
+            # the ack implies fsyncs happened (file + dir per store),
+            # group-committed: the batch paid ONE dir-fsync round per
+            # worker, not one per document
+            assert (global_metrics.get("storage_fsyncs") or 0) > before
+            assert (global_metrics.get("storage_group_commits") or 0) \
+                >= 1
+            # and the raw bytes really are on disk under the docs dirs
+            on_disk = 0
+            for i in range(2):
+                droot = str(tmp_path / f"st{i}" / "documents")
+                for n in DOCS:
+                    if os.path.isfile(os.path.join(droot, n)):
+                        on_disk += 1
+            assert on_disk >= len(DOCS)   # R=2 -> most names twice
+        finally:
+            _stop_all(nodes)
+
+    def test_fsync_off_still_atomic(self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=2, storage_fsync=False)
+        try:
+            resp = _upload(nodes[0])
+            assert not resp.get("failed")
+        finally:
+            _stop_all(nodes)
+
+
+class TestIntegrityScrub:
+    def test_rotten_store_copy_repaired_from_replica(self, core,
+                                                     tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            _upload(leader)
+            store = os.path.join(str(tmp_path / "st0" / "index"),
+                                 "placed_docs")
+            victim = "st3.txt"
+            path = os.path.join(store, victim)
+            assert os.path.isfile(path)
+            good_crc = zlib.crc32(open(path, "rb").read())
+            raw = bytearray(open(path, "rb").read())
+            raw[1] ^= 0x40
+            open(path, "wb").write(bytes(raw))
+            out = leader.run_integrity_scrub()
+            assert out["repaired"] >= 1 and out["unrepaired"] == 0
+            assert zlib.crc32(open(path, "rb").read()) == good_crc
+            assert (global_metrics.get("storage_scrub_repairs") or 0) \
+                >= 1
+        finally:
+            _stop_all(nodes)
+
+    def test_stale_ledger_is_healed_not_quarantined(self, core,
+                                                    tmp_path):
+        """The crash-ate-the-ledger-flush case (chaos-powerloss's exact
+        shape): the local file AND the replicas hold the new acked
+        bytes, only the debounced ledger record is stale. The scrub
+        must heal the RECORD — destroying or refusing the healthy file
+        would lose the leader copy of an acked upsert."""
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            _upload(leader)
+            victim = "st2.txt"
+            good = leader._store_ledger.get(victim)
+            assert good is not None
+            leader._store_ledger.record(victim, good ^ 0xFFFF)  # stale
+            out = leader.run_integrity_scrub()
+            assert out["repaired"] == 0 and out["unrepaired"] == 0
+            assert leader._store_ledger.get(victim) == good
+            assert (global_metrics.get("storage_scrub_ledger_heals")
+                    or 0) >= 1
+            assert leader._store_read(victim) is not None
+        finally:
+            _stop_all(nodes)
+
+    def test_unrepairable_rot_is_loud_never_served(self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=3)
+        try:
+            leader = nodes[0]
+            _upload(leader)
+            store = os.path.join(str(tmp_path / "st0" / "index"),
+                                 "placed_docs")
+            victim = "st5.txt"
+            path = os.path.join(store, victim)
+            raw = bytearray(open(path, "rb").read())
+            raw[1] ^= 0x40
+            open(path, "wb").write(bytes(raw))
+            # no healthy replica anywhere: stop the workers first
+            for nd in nodes[1:]:
+                nd.stop()
+            out = leader.run_integrity_scrub()
+            assert out["unrepaired"] >= 1
+            # the rotten bytes are never served as a recovery source
+            assert leader._store_read(victim) is None
+            assert (global_metrics.get("storage_scrub_unrepaired")
+                    or 0) >= 1
+        finally:
+            _stop_all(nodes)
+
+    def test_scrub_quarantines_corrupt_checkpoint(self, core, tmp_path):
+        nodes = _mk_cluster(core, tmp_path, n=2,
+                            storage_keep_versions=2)
+        try:
+            leader = nodes[0]
+            _upload(leader)
+            leader.save_checkpoint()
+            cur = os.path.join(
+                os.path.dirname(leader.checkpoint_dir),
+                os.readlink(leader.checkpoint_dir))
+            p = os.path.join(cur, "docs.npz")
+            raw = bytearray(open(p, "rb").read())
+            raw[50] ^= 0x01
+            open(p, "wb").write(bytes(raw))
+            out = json.loads(http_post(
+                leader.url + "/admin/scrub", b"{}"))
+            assert out["checkpoints_quarantined"] >= 1
+            assert (global_metrics.get("checkpoint_quarantined")
+                    or 0) >= 1
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# Chaos (slow): whole-cluster power loss under active disk faults
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestChaosPowerloss:
+    @pytest.mark.timeout(300)
+    def test_sigkill_whole_cluster_zero_acked_loss(self, tmp_path):
+        """`make chaos-powerloss` — the one failure class replication
+        alone cannot absorb: a correlated restart of EVERYTHING. A
+        3-node cluster + durable coordinator runs an upload/search
+        workload with the disk nemesis armed (torn writes on the
+        documents dirs); mid-workload every process is SIGKILLed at
+        once, everything restarts on the same dirs, and the bar is
+        zero acked-upload loss with exact single-node-oracle parity on
+        every post-restart search."""
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        env = os.environ.copy()
+        env["TFIDF_JAX_PLATFORM"] = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "TFIDF_REPLICATION_FACTOR": "2",
+            "TFIDF_TOP_K": "200",
+            "TFIDF_SESSION_TIMEOUT_S": "1.0",
+            "TFIDF_HEARTBEAT_INTERVAL_S": "0.2",
+            "TFIDF_RECONCILE_SWEEP_INTERVAL_S": "0.5",
+            "TFIDF_MIN_DOC_CAPACITY": "64",
+            "TFIDF_MIN_NNZ_CAPACITY": "4096",
+            "TFIDF_MIN_VOCAB_CAPACITY": "1024",
+            "TFIDF_QUERY_BATCH": "8",
+            "TFIDF_MAX_QUERY_TERMS": "8",
+            # exercise the checkpoint restore path across the restart
+            "TFIDF_CHECKPOINT_INTERVAL_S": "1.0",
+            # the disk is hostile for the WHOLE run: occasional torn
+            # writes on the raw document stores (an affected upload
+            # fails un-acked; the contract is about what was ACKED)
+            "TFIDF_STORAGE_NEMESIS": json.dumps([
+                {"kind": "torn_write", "glob": "*documents*",
+                 "probability": 0.04},
+            ]),
+        })
+        coord_port = free_port()
+        coord_dir = str(tmp_path / "coord")
+        procs: dict = {}
+
+        def spawn(tag, args):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "tfidf_tpu", *args],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            procs[tag] = p
+            return p
+
+        def wait_pred(pred, timeout=120.0, interval=0.2):
+            deadline = time.monotonic() + timeout
+            last = None
+            while time.monotonic() < deadline:
+                try:
+                    if pred():
+                        return True
+                except Exception as e:
+                    last = e
+                time.sleep(interval)
+            raise AssertionError(f"timed out; last={last!r}")
+
+        def node_args(i, port):
+            return ["serve", "--port", str(port), "--host", "127.0.0.1",
+                    "--coordinator-address", f"127.0.0.1:{coord_port}",
+                    "--documents-path", str(tmp_path / f"pl{i}" / "docs"),
+                    "--index-path", str(tmp_path / f"pl{i}" / "index")]
+
+        def boot_cluster():
+            spawn("coord", ["coordinator", "--listen",
+                            f"127.0.0.1:{coord_port}",
+                            "--data-dir", coord_dir])
+            wait_pred(lambda: socket.create_connection(
+                ("127.0.0.1", coord_port), timeout=1.0).close() or True)
+            for i, p in enumerate(ports):
+                spawn(f"n{i}", node_args(i, p))
+            for u in urls:
+                wait_pred(lambda u=u: http_get_(u + "/api/status"))
+            wait_pred(lambda: len(json.loads(http_get_(
+                urls[0] + "/api/services"))) == 2)
+
+        def http_get_(url):
+            import urllib.request
+            with urllib.request.urlopen(url, timeout=10.0) as r:
+                return r.read()
+
+        def post(url, data, timeout=60.0):
+            import urllib.request
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.read()
+
+        texts = {f"pl{i}.txt":
+                 f"common uniq{i} word{i % 5} tail{i % 11}"
+                 for i in range(120)}
+        queries = ["common", "word1 uniq7", "tail3", "uniq42 common"]
+        ports = [free_port() for _ in range(3)]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        acked: set = set()
+        ambiguous: set = set()
+        try:
+            boot_cluster()
+            names = sorted(texts)
+            batches = [names[lo:lo + 10]
+                       for lo in range(0, len(names), 10)]
+
+            stop = threading.Event()
+
+            def workload():
+                for group in batches:
+                    if stop.is_set():
+                        # everything not yet attempted is ambiguous —
+                        # re-driven after the restart
+                        ambiguous.update(group)
+                        continue
+                    body = json.dumps(
+                        [{"name": n, "text": texts[n]}
+                         for n in group]).encode()
+                    try:
+                        resp = json.loads(post(
+                            urls[0] + "/leader/upload-batch", body))
+                        bad = set(resp.get("failed", ())) \
+                            | {s["name"]
+                               for s in resp.get("skipped", ())}
+                        acked.update(n for n in group if n not in bad)
+                        ambiguous.update(bad)
+                    except Exception:
+                        # no ack — the write may or may not have landed
+                        ambiguous.update(group)
+                    # interleave a search to keep the read plane hot
+                    try:
+                        post(urls[0] + "/leader/start",
+                             json.dumps({"query": "common"}).encode(),
+                             timeout=30.0)
+                    except Exception:
+                        pass
+
+            t = threading.Thread(target=workload, daemon=True)
+            t.start()
+            time.sleep(4.0)   # well into the upload stream
+            # ---- POWER LOSS: kill -9 EVERYTHING at once ----
+            stop.set()
+            for p in procs.values():
+                try:
+                    os.kill(p.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            for p in procs.values():
+                p.wait(timeout=10)
+            t.join(timeout=30)
+            procs.clear()
+            assert acked, "workload never acked anything before the kill"
+
+            # ---- full restart on the same dirs ----
+            boot_cluster()
+            # drive every ambiguous name to a definite acked state so
+            # the corpus is deterministic (idempotent upserts; an acked
+            # doc is NEVER re-sent — if power loss ate one, nothing
+            # below can resurrect it)
+            pending = sorted(set(texts) - acked)
+            deadline = time.monotonic() + 90
+            while pending and time.monotonic() < deadline:
+                body = json.dumps([{"name": n, "text": texts[n]}
+                                   for n in pending[:20]]).encode()
+                try:
+                    resp = json.loads(post(
+                        urls[0] + "/leader/upload-batch", body))
+                    bad = set(resp.get("failed", ())) | {
+                        s["name"] for s in resp.get("skipped", ())}
+                    done = [n for n in pending[:20] if n not in bad]
+                    pending = [n for n in pending if n not in done]
+                except Exception:
+                    time.sleep(1.0)
+            assert not pending, f"could not settle {len(pending)} docs"
+
+            # ---- the bar: zero acked loss, exact oracle parity ----
+            oracle_cfg = Config(
+                documents_path=str(tmp_path / "oracle" / "docs"),
+                index_path=str(tmp_path / "oracle" / "index"),
+                top_k=200, min_doc_capacity=64,
+                min_nnz_capacity=4096, min_vocab_capacity=1024,
+                query_batch=8, max_query_terms=8)
+            from tfidf_tpu.engine.engine import Engine
+            oracle = Engine(oracle_cfg)
+            for n, txt in texts.items():
+                oracle.ingest_text(n, txt)
+            oracle.commit()
+
+            def parity(q):
+                want = {h.name: float(h.score)
+                        for h in oracle.search(q, k=200)}
+                got = {n: float(s) for n, s in json.loads(post(
+                    urls[0] + "/leader/start",
+                    json.dumps({"query": q}).encode())).items()}
+                assert set(got) == set(want), \
+                    (q, set(want) - set(got), set(got) - set(want))
+                for n, s in want.items():
+                    assert got[n] == pytest.approx(s, rel=1e-5), \
+                        (q, n, got[n], s)
+                return True
+
+            for q in queries:
+                wait_pred(lambda q=q: parity(q), timeout=120,
+                          interval=1.0)
+            # every ACKED doc individually findable — the acked-loss
+            # probe at single-document granularity
+            for i in range(120):
+                n = f"pl{i}.txt"
+                if n not in acked:
+                    continue
+                got = json.loads(post(
+                    urls[0] + "/leader/start",
+                    json.dumps({"query": f"uniq{i}"}).encode()))
+                assert n in got, f"ACKED {n} lost through power loss"
+        finally:
+            for p in procs.values():
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    pass

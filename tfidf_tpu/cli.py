@@ -124,13 +124,19 @@ def cmd_serve(args) -> int:
     newer_than = None
     ckpt_dir = cfg.checkpoint_path or os.path.join(cfg.index_path,
                                                    "checkpoint")
-    if os.path.isdir(ckpt_dir):
-        from tfidf_tpu.engine.checkpoint import load_checkpoint
+    # fallback-aware restore: the manifest of every candidate version
+    # is verified, corrupt ones are quarantined, and the newest INTACT
+    # version wins — a torn or bit-rotted checkpoint costs a fallback
+    # (or, at worst, the full re-walk below), never silently wrong
+    # scores. Gated on checkpoint_versions, NOT isdir: a quarantine
+    # leaves the published symlink dangling (isdir follows it to
+    # False), and the intact .v<N-1> fallback must still be consulted.
+    from tfidf_tpu.engine.checkpoint import (checkpoint_versions,
+                                             restore_checkpoint)
+    if checkpoint_versions(ckpt_dir):
         try:
-            engine = load_checkpoint(ckpt_dir, cfg)
-            with open(os.path.join(ckpt_dir, "meta.json"),
-                      encoding="utf-8") as f:
-                created = json.load(f).get("created_at")
+            engine, meta = restore_checkpoint(ckpt_dir, cfg)
+            created = meta.get("created_at")
             if created:
                 newer_than = float(created) - 60.0   # clock-skew slack
             # reconcile deletions: the partial re-walk only UPSERTS, so
@@ -815,6 +821,50 @@ def cmd_faults(args) -> int:
     return 2
 
 
+def cmd_scrub(args) -> int:
+    """``scrub``: storage-integrity verification. With ``--url`` it
+    triggers one scrub pass on a RUNNING node (``POST /admin/scrub`` —
+    the same pass the leader's sweep loop runs every
+    ``storage_scrub_ms``); otherwise it verifies the local on-disk
+    state offline: every checkpoint version's manifest and every
+    placed-docs CRC against the ledger. Exit 1 on any corruption —
+    the loud-refusal half of the storage contract."""
+    from tfidf_tpu.utils import storage as st
+
+    if args.url:
+        from tfidf_tpu.cluster.node import http_post
+        resp = json.loads(http_post(
+            args.url.rstrip("/") + "/admin/scrub", b"{}"))
+        print(json.dumps(resp, indent=1))
+        return 1 if resp.get("unrepaired") \
+            or resp.get("checkpoints_quarantined") else 0
+    cfg = _load_cfg(args)
+    ckpt_bad = 0
+    from tfidf_tpu.engine.checkpoint import checkpoint_versions
+    ckpt = cfg.checkpoint_path or os.path.join(cfg.index_path,
+                                               "checkpoint")
+    for vdir in checkpoint_versions(ckpt):
+        problems = st.verify_manifest(vdir)
+        status = "OK" if not problems else "; ".join(problems)
+        print(f"checkpoint {vdir}: {status}")
+        ckpt_bad += bool(problems)
+    ledger = st.CrcLedger(os.path.join(cfg.index_path,
+                                       "placed_docs.crc.json"))
+    store = os.path.join(cfg.index_path, "placed_docs")
+    checked = store_bad = 0
+    for name in sorted(ledger.names()):
+        path = os.path.join(store, name)
+        if not os.path.isfile(path):
+            continue
+        checked += 1
+        if st.file_crc(path) != ledger.get(name):
+            print(f"placed_docs {name}: CRC MISMATCH")
+            store_bad += 1
+    print(f"placed_docs: {checked} file(s) checked, "
+          f"{store_bad} problem(s); checkpoints: {ckpt_bad} problem(s)")
+    return 1 if ckpt_bad or store_bad else 0
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root, not inside the package
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -959,6 +1009,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("bench", help="run the TPU benchmark")
     s.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser("scrub",
+                       help="storage-integrity verification: checkpoint "
+                            "manifests + placed-docs CRC ledger")
+    s.add_argument("--url",
+                   help="trigger one scrub pass on a running node "
+                        "(POST /admin/scrub) instead of offline "
+                        "verification")
+    s.add_argument("--index-path")
+    s.add_argument("--documents-path")
+    s.set_defaults(fn=cmd_scrub)
 
     s = sub.add_parser("faults",
                        help="chaos tooling: inspect fault points")

@@ -34,10 +34,10 @@ contract)."""
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 
+from tfidf_tpu.utils.storage import atomic_write_json, read_json
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
 
@@ -66,14 +66,20 @@ class FenceGuard:
         self._lock = threading.Lock()
         self._epoch = -1                      # -1 = never saw an epoch
         try:
-            with open(self._path, encoding="utf-8") as f:
-                self._epoch = int(json.load(f)["epoch"])
+            # the checksummed read (utils/storage.py) matters here more
+            # than anywhere: a flipped digit in the epoch is VALID JSON
+            # with a lower value — silently accepting it would let a
+            # deposed leader capture this worker after a reboot. A CRC
+            # mismatch lands in the loud-permissive branch below
+            # instead, exactly like a torn file.
+            self._epoch = int(read_json(self._path)["epoch"])
         except FileNotFoundError:
             pass
         except Exception as e:
             # unreadable fence state: start permissive (equivalent to a
             # brand-new worker) but say so — silent strictness could
             # wedge a healthy cluster on one corrupt byte
+            global_metrics.inc("fence_state_unreadable")
             log.warning("fence state unreadable; starting fresh",
                         path=path, err=repr(e))
 
@@ -107,9 +113,9 @@ class FenceGuard:
         d = os.path.dirname(self._path)
         if d:
             os.makedirs(d, exist_ok=True)
-        tmp = f"{self._path}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"epoch": self._epoch}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path)
+        # checksummed atomic publish through the durable-IO seam:
+        # temp + CRC envelope + fsync file + rename + fsync dir — a
+        # torn write can never be mistaken for a lower (or higher)
+        # epoch on reload (reviewed fsync-under-lock — graftcheck
+        # allowlist)
+        atomic_write_json(self._path, {"epoch": self._epoch})

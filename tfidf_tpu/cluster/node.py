@@ -39,6 +39,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler  # noqa: F401 (re-export)
@@ -72,6 +73,7 @@ from tfidf_tpu.cluster.router import (ScatterReadPlane, _HttpHandlerBase,
                                       list_routers)
 from tfidf_tpu.engine.engine import Engine
 from tfidf_tpu.ops.analyzer import UnsupportedMediaType
+from tfidf_tpu.utils import storage
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
@@ -494,6 +496,14 @@ class SearchNode(ScatterReadPlane):
         # (that would double-count them in the scatter sum-merge)
         self._store_dir = os.path.join(self.config.index_path,
                                        "placed_docs")
+        # name -> CRC32 of every stored placed document: the reference
+        # the integrity scrub verifies against (without an independent
+        # record, bit rot in a stored doc is undetectable — the bytes
+        # are their own only witness). Flushes are debounced onto the
+        # sweep loop's scrub pass.
+        self._store_ledger = storage.CrcLedger(
+            os.path.join(self.config.index_path, "placed_docs.crc.json"))
+        self._scrub_last = time.monotonic()
 
         # serving-node durability (the reference commits its Lucene index
         # on every upload, Worker.java:138): an on-demand /admin/checkpoint
@@ -594,6 +604,7 @@ class SearchNode(ScatterReadPlane):
 
     def stop(self) -> None:
         self._stopping = True
+        self._store_ledger.flush(fsync=False)   # best-effort final flush
         self.placement.stop()
         if self.placement_follower is not None:
             self.placement_follower.stop()
@@ -1155,28 +1166,181 @@ class SearchNode(ScatterReadPlane):
     def _store_document(self, name: str, data: bytes) -> None:
         """Durable leader-side copy of a placed document (the recovery
         source; the reference's leader-local disk is already a download
-        source, ``Leader.java:112-121``). Best-effort: a failed store
-        must not fail the upload it shadows."""
+        source, ``Leader.java:112-121``). Atomic + group-commit-fsynced
+        through the durable-IO seam, with the CRC recorded in the scrub
+        ledger. Best-effort: a failed store must not fail the upload it
+        shadows (the replicas ARE durable — fsync-before-ack)."""
         try:
             path = self._store_path(name)
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.part"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
+            storage.atomic_write_bytes(path, data,
+                                       fsync=self.config.storage_fsync)
+            self._store_ledger.record(name, zlib.crc32(data))
         except Exception as e:
             log.warning("leader document store write failed", file=name,
                         err=repr(e))
 
     def _store_read(self, name: str) -> bytes | None:
+        """Read a stored placed document, verified against the scrub
+        ledger when it has a record — a rotten recovery source must
+        surface as MISSING (so recovery falls through to the replica
+        download probe), never get re-placed as corrupt content."""
         try:
             path = self._store_path(name)
             if not os.path.isfile(path):
                 return None
-            with open(path, "rb") as f:
-                return f.read()
+            data = storage.read_bytes(path)
+            want = self._store_ledger.get(name)
+            if want is not None:
+                if zlib.crc32(data) != want:
+                    global_metrics.inc("storage_corruptions_detected")
+                    span_event("storage_corruption", file=name,
+                               where="placed_docs")
+                    log.warning("stored document failed CRC; treating "
+                                "as missing", file=name)
+                    return None
+            return data
         except Exception:
             return None
+
+    # ---- background integrity scrub (storage durability, README
+    #      "Storage durability & integrity") ----
+
+    def run_integrity_scrub(self) -> dict:
+        """One scrub pass: verify every ledger-covered placed document
+        against its recorded CRC, repairing a rotten local copy from a
+        healthy replica through the same download probe the PR 5
+        recovery uses; then verify the current checkpoint's manifest,
+        quarantining a corrupt version (the next autosave re-creates
+        it). Rides the leader's sweep loop (``storage_scrub_ms``);
+        public so tests and operators can force a pass."""
+        checked = repaired = unrepaired = 0
+        for name in self._store_ledger.names():
+            if self._stopping:
+                break
+            want = self._store_ledger.get(name)
+            try:
+                path = self._store_path(name)
+            except PermissionError:
+                continue
+            if want is None or not os.path.isfile(path):
+                continue
+            checked += 1
+            try:
+                got = storage.file_crc(path)
+            except OSError:
+                got = None
+            if got == want:
+                continue
+            # TOCTOU guard: a concurrent upsert may have rewritten the
+            # file between the ledger read and the CRC — re-read the
+            # ledger and skip if it moved (the NEXT pass judges the new
+            # pair); without this, scrub could "repair" a just-acked
+            # upsert back to its replica's OLD bytes or condemn a
+            # perfectly valid new file
+            if self._store_ledger.get(name) != want:
+                continue
+            # corroborate against a replica before judging (anti-
+            # entropy: the workers holding the doc are the redundancy
+            # this store backs). NOT leader_download — its locator
+            # serves the local store first, which is exactly the copy
+            # under suspicion.
+            data = self._fetch_from_replicas(name)
+            rcrc = zlib.crc32(data) if data is not None else None
+            if rcrc is not None and got is not None and rcrc == got:
+                # the replica agrees with the LOCAL FILE, not the
+                # ledger: the ledger record is stale (a crash ate the
+                # debounced flush after an acked upsert) — heal the
+                # RECORD, never touch the healthy file
+                self._store_ledger.record(name, got)
+                global_metrics.inc("storage_scrub_ledger_heals")
+                log.info("scrub healed stale ledger record (replica "
+                         "corroborates the local file)", file=name)
+                continue
+            global_metrics.inc("storage_scrub_corruptions")
+            span_event("storage_corruption", file=name,
+                       where="placed_docs")
+            if rcrc == want and self._store_ledger.get(name) == want:
+                try:
+                    storage.atomic_write_bytes(
+                        path, data, fsync=self.config.storage_fsync)
+                    repaired += 1
+                    global_metrics.inc("storage_scrub_repairs")
+                    log.info("scrub repaired rotten stored document "
+                             "from a replica", file=name)
+                    continue
+                except OSError as e:
+                    log.warning("scrub repair write failed", file=name,
+                                err=repr(e))
+            if self._store_ledger.get(name) != want:
+                continue   # upsert landed mid-repair: next pass judges
+            unrepaired += 1
+            global_metrics.inc("storage_scrub_unrepaired")
+            # deliberately NON-destructive: ledger-vs-file disagreement
+            # with no replica corroboration either way could be a
+            # rotten file OR a healthy upsert whose ledger flush a
+            # crash ate — destroying the bytes on that evidence could
+            # delete the only leader copy of an acked write. The pair
+            # stays on disk, loudly recounted each pass; _store_read
+            # keeps refusing the mismatch, so the suspect bytes are
+            # never served as a recovery source either way.
+            log.warning("scrub found ledger/file CRC disagreement with "
+                        "no replica corroboration; leaving both in "
+                        "place (recovery falls back to the download "
+                        "probe)", file=name)
+        self._store_ledger.flush(fsync=self.config.storage_fsync)
+        # checkpoint integrity: a corrupt CURRENT version is quarantined
+        # now, while the fallback version still exists — not discovered
+        # at the next boot, when the re-walk bill comes due
+        ckpt_bad = 0
+        from tfidf_tpu.engine.checkpoint import (checkpoint_versions,
+                                                 quarantine_version)
+        for vdir in checkpoint_versions(self.checkpoint_dir):
+            problems = storage.verify_manifest(vdir)
+            if problems and all("manifest missing" in p
+                                for p in problems):
+                # pre-manifest legacy version: unverifiable, not
+                # corrupt — restore_checkpoint keeps it loadable as a
+                # last resort, so the scrub must not destroy it (the
+                # next save supersedes it with a manifested one)
+                continue
+            if problems:
+                ckpt_bad += 1
+                span_event("storage_corruption",
+                           file=os.path.basename(vdir),
+                           where="checkpoint")
+                log.warning("scrub found corrupt checkpoint version",
+                            dir=vdir, problems=problems[:3])
+                quarantine_version(vdir)
+        global_metrics.inc("storage_scrub_passes")
+        out = {"checked": checked, "repaired": repaired,
+               "unrepaired": unrepaired, "checkpoints_quarantined":
+               ckpt_bad}
+        if repaired or unrepaired or ckpt_bad:
+            log.info("integrity scrub pass", **out)
+        return out
+
+    def _fetch_from_replicas(self, name: str) -> bytes | None:
+        """Fetch a document's bytes from the worker fleet ONLY (never
+        the local durable store — the scrub calls this exactly when the
+        local copy is the rotten one). Same probe discipline as
+        ``leader_download_stream``'s worker loop."""
+        q = urllib.parse.quote(name)
+        for w in self.registry.get_all_service_addresses():
+            if self.resilience.board.is_open(w):
+                continue
+            try:
+                resp = self.resilience.worker_call(
+                    w, lambda w=w: http_get_stream(
+                        w + f"/worker/download?path={q}", timeout=30.0,
+                        origin=self.url),
+                    retry=False)
+                try:
+                    return resp.read()
+                finally:
+                    resp.close()
+            except Exception:
+                continue
+        return None
 
     def _on_membership_change(self, old, new) -> None:
         """Registry watch hook (watch-dispatch thread — hand off fast).
@@ -1319,6 +1483,13 @@ class SearchNode(ScatterReadPlane):
                         >= self.config.residue_sweep_ms / 1e3):
                     self._residue_last = now
                     self.run_residue_reconcile()
+                # background integrity scrub (storage durability),
+                # self-paced by storage_scrub_ms
+                if (self.config.storage_scrub_ms >= 0
+                        and now - self._scrub_last
+                        >= self.config.storage_scrub_ms / 1e3):
+                    self._scrub_last = now
+                    self.run_integrity_scrub()
             except Exception as e:
                 log.warning("reconcile sweep pass failed", err=repr(e))
 
@@ -1970,6 +2141,7 @@ class SearchNode(ScatterReadPlane):
                 for w, group in per_worker.items()}
         placed = {}
         errors = {}
+        full_disk_errors: dict[str, Exception] = {}
         skipped_by_name: dict[str, dict] = {}
         confirmed_names: set[str] = set()
         for fut, (w, group) in futs.items():
@@ -1986,8 +2158,17 @@ class SearchNode(ScatterReadPlane):
                     resp = fut.result(timeout=1200.0)
             except Exception as e:
                 errors[w] = repr(e)
+                # a 507 (disk full) is an app-level verdict from a
+                # healthy, reachable worker: never evict its size
+                # cache (a transport-failure remedy), and remember the
+                # exception so an all-full-disks batch relays 507
+                # instead of a retryable 500
+                if isinstance(e, urllib.error.HTTPError) \
+                        and e.code == storage.STORAGE_FULL_STATUS:
+                    full_disk_errors[w] = e
                 app_reject = (isinstance(e, urllib.error.HTTPError)
-                              and e.code < 500)
+                              and (e.code < 500 or e.code
+                                   == storage.STORAGE_FULL_STATUS))
                 for d in group:   # settle EVERY leg, claimed or held
                     self.placement.leg_failure(d["name"], w)
                 if not app_reject:      # fast re-poll on transport
@@ -2016,6 +2197,12 @@ class SearchNode(ScatterReadPlane):
                         d["name"], d.get("text", "").encode("utf-8"))
         global_metrics.inc("uploads_placed", len(confirmed_names))
         if errors and not placed:
+            if len(full_disk_errors) == len(errors):
+                # every leg answered 507: relay the distinct disk-full
+                # verdict (non-retryable, never a breaker trip) rather
+                # than a generic 500 the client would classify as a
+                # retryable worker fault
+                raise next(iter(full_disk_errors.values()))
             raise RuntimeError(f"all workers failed: {errors}")
         out = {"placed": placed}
         if skipped_by_name:
@@ -2487,6 +2674,14 @@ class _NodeHandler(_HttpHandlerBase):
                     # never index binary bytes as mojibake
                     self._text(f"unsupported media type: {e}", 415)
                     return
+                except OSError as e:
+                    if not storage.is_enospc(e):
+                        raise
+                    # disk full: the distinct 507 — non-retryable by
+                    # classification and never a breaker trip (a node
+                    # with a full disk still serves reads perfectly)
+                    self._text("insufficient storage (disk full)", 507)
+                    return
                 node.notify_write()
                 # a direct worker-side write also changes THIS node's
                 # df — keep its own result cache honest (dual-role and
@@ -2499,22 +2694,63 @@ class _NodeHandler(_HttpHandlerBase):
                     return
                 global_injector.check("worker.upload")
                 skipped = []
+                staged: list[tuple] = []   # (name, tmp, path, text)
+                enospc = False
+                durable = node.config.storage_fsync
                 try:
+                    # two-phase group commit (fsync-before-ack without
+                    # one fsync per document): stage every temp, ONE
+                    # committer round over all of them, then publish
+                    # renames + index, then ONE round over the unique
+                    # directories — 2 fsync rounds per batch
                     for d in docs:
                         try:
-                            node.engine.ingest_bytes(
-                                d["name"], d["text"].encode("utf-8"),
-                                save_to_disk=True)
+                            staged.append((d["name"],
+                                           *node.engine.stage_bytes(
+                                               d["name"],
+                                               d["text"].encode(
+                                                   "utf-8"))))
                         except UnsupportedMediaType as e:
                             skipped.append({"name": d["name"],
                                             "error": str(e)})
+                        except OSError as e:
+                            if not storage.is_enospc(e):
+                                raise
+                            enospc = True
+                            break
+                    # ENOSPC is mapped to 507 from the fsync rounds
+                    # too: with delayed allocation, fsync can be the
+                    # FIRST syscall to report a full disk — a 500 here
+                    # would trip the breaker the 507 contract protects
+                    try:
+                        if durable and staged and not enospc:
+                            storage.global_committer.sync(
+                                [t[1] for t in staged])
+                        dirs: set = set()
+                        if not enospc:
+                            for name, tmp, path, text in staged:
+                                node.engine.publish_staged(
+                                    name, tmp, path, text)
+                                dirs.add(os.path.dirname(path))
+                            staged = []
+                        if durable and dirs:
+                            storage.global_committer.sync(sorted(dirs))
+                    except OSError as e:
+                        if not storage.is_enospc(e):
+                            raise
+                        enospc = True
                 finally:
+                    for _name, tmp, _path, _text in staged:
+                        node.engine.discard_staged(tmp)
                     # mark dirty even on a mid-batch failure: the docs
                     # already ingested must become searchable at the
                     # next NRT flush, not be stranded uncommitted
                     if docs:
                         node.notify_write()
                         node.bump_result_generation()
+                if enospc:
+                    self._text("insufficient storage (disk full)", 507)
+                    return
                 self._json({"indexed": len(docs) - len(skipped),
                             "skipped": skipped})
             elif u.path == "/worker/delete":
@@ -2572,7 +2808,17 @@ class _NodeHandler(_HttpHandlerBase):
                 # on-demand durability point (reference analog: the
                 # per-upload indexWriter.commit(), Worker.java:138)
                 node.commit_if_dirty()
-                self._json(node.save_checkpoint())
+                try:
+                    self._json(node.save_checkpoint())
+                except OSError as e:
+                    if not storage.is_enospc(e):
+                        raise
+                    self._text("insufficient storage (disk full)", 507)
+            elif u.path == "/admin/scrub":
+                # on-demand integrity-scrub pass (README "Storage
+                # durability & integrity"); the sweep loop runs the
+                # same pass on the storage_scrub_ms cadence
+                self._json(node.run_integrity_scrub())
             elif u.path == "/leader/upload-batch":
                 # uploads are bulk by default: first to shed under
                 # backpressure, so ingest never crowds out interactive
@@ -2595,6 +2841,13 @@ class _NodeHandler(_HttpHandlerBase):
                         self._json(node.leader_upload_batch(docs))
                     except ValueError as e:  # malformed client payload
                         self._text(str(e), 400)
+                    except urllib.error.HTTPError as e:
+                        if e.code != storage.STORAGE_FULL_STATUS:
+                            raise
+                        # every replica leg reported a full disk:
+                        # relay the distinct verdict
+                        self._text("insufficient storage "
+                                   "(worker disks full)", 507)
             elif u.path == "/leader/start":
                 # the shared read-plane search branch
                 # (cluster/router.py): front-door admission BEFORE any
@@ -2640,6 +2893,13 @@ class _NodeHandler(_HttpHandlerBase):
                     except urllib.error.HTTPError as e:
                         if e.code == 415:  # worker refused the format
                             self._text("unsupported media type", 415)
+                            return
+                        if e.code == storage.STORAGE_FULL_STATUS:
+                            # relay the worker's disk-full verdict
+                            # distinctly: the client must not classify
+                            # a full disk as a retryable 5xx
+                            self._text("insufficient storage "
+                                       "(worker disk full)", 507)
                             return
                         raise
                     self._text(f"File uploaded successfully to worker: "

@@ -142,6 +142,15 @@ def retry_after_of(e: BaseException) -> float | None:
 # the leader must step down, not merely fail the request.
 _FENCE_STATUS = 403
 
+# disk full (utils/storage.py STORAGE_FULL_STATUS): an upload or
+# checkpoint hit ENOSPC. Deliberately NON-retryable (a full disk does
+# not drain on retry timescales; hammering it multiplies write load
+# exactly when the disk needs relief) and NEVER a worker fault — the
+# node still serves reads perfectly, so a breaker that opened on 507s
+# would mark a healthy-for-reads node dead and shrink the very capacity
+# the full disk is starving.
+_STORAGE_FULL_STATUS = 507
+
 
 def is_fence_rejection(e: BaseException) -> bool:
     """A worker's leadership-fence rejection (403 +
@@ -208,9 +217,9 @@ def is_worker_fault(e: BaseException) -> bool:
     if isinstance(e, RpcStatusError):
         if e.deadline_exceeded:
             return False   # honest refusal from a healthy worker
-        return e.status >= 500
+        return e.status >= 500 and e.status != _STORAGE_FULL_STATUS
     if isinstance(e, urllib.error.HTTPError):
-        return e.code >= 500
+        return e.code >= 500 and e.code != _STORAGE_FULL_STATUS
     return True
 
 

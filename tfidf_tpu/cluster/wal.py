@@ -37,6 +37,8 @@ import struct
 import zlib
 from typing import Any
 
+from tfidf_tpu.utils.storage import (atomic_write_bytes,
+                                     atomic_write_json, read_json)
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
@@ -104,16 +106,18 @@ class DurableStore:
         meta = {"term": 0, "voted_for": None}
         if os.path.exists(self._meta_path):
             try:
-                with open(self._meta_path, encoding="utf-8") as f:
-                    meta.update(json.load(f))
+                # checksummed read (utils/storage.py): bit rot in the
+                # hard state is detected, not parsed — a flipped digit
+                # in `term` is valid JSON that re-votes in a past term.
+                # StorageCorruption is a ValueError: caught below.
+                meta.update(read_json(self._meta_path))
             except (ValueError, OSError) as e:
                 log.warning("raft meta unreadable; starting at term 0",
                             err=repr(e))
         snapshot: dict | None = None
         if os.path.exists(self._snap_path):
             try:
-                with open(self._snap_path, encoding="utf-8") as f:
-                    snapshot = json.load(f)
+                snapshot = read_json(self._snap_path)
                 if not {"last_index", "last_term",
                         "state"} <= set(snapshot):
                     raise ValueError("snapshot missing fields")
@@ -193,32 +197,34 @@ class DurableStore:
 
     def rewrite(self, entries: list[dict]) -> None:
         """Atomically replace the WAL with exactly ``entries`` (conflict
-        truncation after a leader change; compaction after snapshot)."""
-        tmp = self._wal_path + ".tmp"
-        with open(tmp, "wb") as f:
-            for e in entries:
-                f.write(encode_frame(
-                    json.dumps(e, separators=(",", ":")).encode()))
-            f.flush()
-            os.fsync(f.fileno())
+        truncation after a leader change; compaction after snapshot) —
+        temp + fsync + rename through the durable-IO seam."""
+        buf = b"".join(
+            encode_frame(json.dumps(e, separators=(",", ":")).encode())
+            for e in entries)
         self._fh.close()
-        os.replace(tmp, self._wal_path)
-        self._fh = open(self._wal_path, "ab")
+        try:
+            atomic_write_bytes(self._wal_path, buf, fsync=True)
+        finally:
+            # reopen even when the seam write fails (ENOSPC, armed
+            # nemesis): the atomic publish left the old log intact, and
+            # a permanently-closed handle would crash every later
+            # append with a non-OSError nothing upstream classifies
+            self._fh = open(self._wal_path, "ab")
         global_metrics.inc("wal_rewrites")
 
     def write_snapshot(self, state: dict, last_index: int,
                        last_term: int) -> None:
         """Atomically persist a snapshot at ``last_index`` (the slow
         half: full-state JSON + fsync; callers may run it outside
-        their locks — it touches only the snapshot file)."""
+        their locks — it touches only the snapshot file). Checksummed
+        through the durable-IO seam, so the frame-checksummed WAL is no
+        longer the only coordination file that can PROVE its bytes."""
         global_injector.check("wal.snapshot")
-        tmp = self._snap_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"last_index": last_index, "last_term": last_term,
-                       "state": state}, f, separators=(",", ":"))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path)
+        atomic_write_json(
+            self._snap_path,
+            {"last_index": last_index, "last_term": last_term,
+             "state": state})
         global_metrics.inc("wal_snapshots")
 
     def save_snapshot(self, state: dict, last_index: int, last_term: int,
@@ -235,12 +241,8 @@ class DurableStore:
     def set_meta(self, term: int, voted_for: str | None) -> None:
         """Persist (term, voted_for) BEFORE any vote/append response —
         a node must never vote twice in a term across a restart."""
-        tmp = self._meta_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"term": term, "voted_for": voted_for}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._meta_path)
+        atomic_write_json(
+            self._meta_path, {"term": term, "voted_for": voted_for})
 
     def close(self) -> None:
         try:

@@ -13,6 +13,7 @@ over that rebuild, not a requirement for correctness.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 
@@ -23,12 +24,16 @@ from tfidf_tpu.engine.vocab import NativeVocabulary, Vocabulary
 from tfidf_tpu.models.base import get_model
 from tfidf_tpu.ops.analyzer import (Analyzer, UnsupportedMediaType,
                                     extract_text)
+from tfidf_tpu.utils import storage
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.logging import Stopwatch, get_logger
 from tfidf_tpu.utils.metrics import global_metrics
 from tfidf_tpu.utils.tracing import trace_phase
 
 log = get_logger("engine")
+
+# staged-upload temp-name uniquifier (see Engine.stage_bytes)
+_STAGE_SEQ = itertools.count()
 
 
 class Engine:
@@ -169,31 +174,90 @@ class Engine:
         (the reference's ``Files.copy`` to ``${mydocument.path}``,
         ``Worker.java:133-134``), then extract + index.
 
-        The write lock spans BOTH the disk write and the indexing so
+        fsync-before-ack (``config.storage_fsync``): the raw bytes are
+        fsynced — group-committed across concurrent upload threads
+        (``utils.storage.GroupCommitter``) — BEFORE the rename that
+        publishes them, and the parent directory is fsynced before this
+        returns, so an acked upload survives whole-cluster power loss.
+        The file fsync must precede the rename: an upsert that renamed
+        first could replace previously-ACKED bytes with an unflushed
+        file a crash then tears. (The batch upload handler uses the
+        two-phase :meth:`stage_bytes` / :meth:`publish_staged` pair
+        instead — two group-commit rounds per batch rather than
+        per-document fsyncs.)
+
+        The write lock spans the publish rename AND the indexing so
         concurrent same-name uploads leave disk and index agreeing on
         one writer's content — otherwise a restart's
         ``build_from_directory`` re-walk could silently flip search
-        results to the other writer's version."""
-        # extract before taking the lock: an UnsupportedMediaType must
+        results to the other writer's version. (The temp-file write and
+        its fsync run outside the lock — each writer owns a unique temp
+        name, and serializing group-committed fsyncs under the lock
+        would defeat the group.)"""
+        # extract before any disk work: an UnsupportedMediaType must
         # refuse without leaving bytes on disk, and extraction needs no
         # shared state
         text = extract_text(data)
-        with self._write_lock:
-            if save_to_disk:
-                path = self._safe_doc_path(name)
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                # unique temp per writer: concurrent uploads of the SAME
-                # name sharing one ".part" path race — the loser's
-                # os.replace dies after the winner moved it away
-                tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.part"
-                try:
-                    with open(tmp, "wb") as f:
-                        f.write(data)
-                    os.replace(tmp, path)
-                finally:
-                    if os.path.exists(tmp):
-                        os.unlink(tmp)
+        if not save_to_disk:
             self.ingest_text(name, text)
+            return
+        path = self._safe_doc_path(name)
+        d = os.path.dirname(path)
+        os.makedirs(d, exist_ok=True)
+        # unique temp per writer: concurrent uploads of the SAME name
+        # sharing one ".part" path race — the loser's rename dies after
+        # the winner moved it away
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.part"
+        durable = self.config.storage_fsync
+        try:
+            storage.write_bytes(tmp, data)
+            if durable:
+                storage.global_committer.sync([tmp])
+            with self._write_lock:
+                storage.replace(tmp, path)
+                self.ingest_text(name, text)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        if durable:
+            storage.global_committer.sync([d])
+
+    def stage_bytes(self, name: str, data: bytes) -> tuple[str, str, str]:
+        """First half of the batched durable upload: extract + write
+        the raw bytes to a unique temp, NO fsync, NO indexing yet.
+        Returns ``(tmp, final_path, text)`` for :meth:`publish_staged`.
+        The batch handler stages every document, group-fsyncs ALL the
+        temps in one committer round, then publishes — two fsync
+        rounds per batch instead of one per document, which is what
+        lets ingest throughput survive fsync-before-ack."""
+        text = extract_text(data)
+        path = self._safe_doc_path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # globally unique temp: a batch may legally contain the same
+        # name twice (last upsert wins), and both stagings must coexist
+        tmp = f"{path}.{os.getpid()}.{next(_STAGE_SEQ)}.part"
+        storage.write_bytes(tmp, data)
+        return tmp, path, text
+
+    def publish_staged(self, name: str, tmp: str, path: str,
+                      text: str) -> None:
+        """Second half: publish rename + index under the write lock
+        (same disk/index agreement contract as ``ingest_bytes``). The
+        caller has already fsynced ``tmp`` — renaming an unflushed
+        temp over previously-acked bytes is the upsert-tear hazard."""
+        with self._write_lock:
+            storage.replace(tmp, path)
+            self.ingest_text(name, text)
+
+    def discard_staged(self, tmp: str) -> None:
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
 
     def delete(self, name: str) -> bool:
         with self._write_lock:

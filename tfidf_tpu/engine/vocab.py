@@ -62,11 +62,13 @@ class Vocabulary:
         return out
 
     def save(self, path: str) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            for t in self.all_terms():
-                f.write(t + "\n")
-        os.replace(tmp, path)
+        # through the durable-IO seam (utils/storage.py): the vocab is
+        # a checkpoint file — its manifest CRC and fsync happen at
+        # directory-publish time, so the write itself skips the fsync
+        from tfidf_tpu.utils import storage
+        storage.atomic_write_bytes(
+            path, "".join(t + "\n" for t in self.all_terms()).encode(),
+            fsync=False)
 
     def load_into(self, path: str) -> None:
         """Append every term from a vocab file, in order (checkpoint

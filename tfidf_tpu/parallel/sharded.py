@@ -619,13 +619,12 @@ def save_sharded_arrays(arrays: ShardedArrays, path: str) -> None:
     The host copy of every field is fetched once; restore re-places the
     blocks on any mesh with the same (D, T) shape.
     """
-    import os
+    from tfidf_tpu.utils import storage
     data = {f: np.asarray(getattr(arrays, f)) for f in _CKPT_FIELDS}
     data["meta"] = np.asarray([arrays.doc_cap, arrays.vocab_cap], np.int64)
     tmp = path + ".part"
-    with open(tmp, "wb") as fh:
-        np.savez(fh, **data)
-    os.replace(tmp, path)
+    storage.savez(tmp, **data)
+    storage.replace(tmp, path)
 
 
 def load_sharded_arrays(path: str, mesh: Mesh) -> ShardedArrays:

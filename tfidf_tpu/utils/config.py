@@ -181,6 +181,28 @@ class Config:
     # streaming rates; the segment backlog then grows unboundedly.
     merge_workers: int = 2
 
+    # --- storage durability (utils/storage.py) ---
+    # fsync-before-ack: an acked upload's raw bytes are fsynced (file +
+    # directory, group-committed across concurrent requests) BEFORE the
+    # HTTP 200 leaves the worker — the WAL's durability contract
+    # applied to the data plane. Off trades the crash window for
+    # throughput (tests, ephemeral deployments); atomic-rename publish
+    # stays on either way.
+    storage_fsync: bool = True
+    # Versioned checkpoint dirs retained after a successful publish
+    # (the current one plus N-1 fallbacks). Load falls back to the
+    # newest INTACT version when the manifest check fails, quarantining
+    # the corrupt one — with 1, there is nothing to fall back to.
+    storage_keep_versions: int = 2
+    # Background integrity-scrub pacing inside the leader's sweep loop
+    # (verify placed_docs CRCs against the ledger + the current
+    # checkpoint manifest; repair rotten copies from healthy replicas
+    # through the anti-entropy machinery). Each pass re-reads the whole
+    # store, so the default is minutes, not seconds — real scrubbers
+    # run on hour scales. Negative disables; run_integrity_scrub()
+    # still works on demand (POST /admin/scrub).
+    storage_scrub_ms: float = 600000.0
+
     # --- checkpoint ---
     # Also store the committed snapshot's device arrays in checkpoints
     # so restore skips the O(corpus) host re-layout (~6x faster restore

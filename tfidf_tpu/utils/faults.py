@@ -79,6 +79,15 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
                                 "probe (observe-only)",
     "checkpoint.pre_publish": "checkpoint written but not yet published "
                               "(crash window)",
+    "storage.write": "durable-IO seam about to write a file's bytes "
+                     "(utils/storage.py; torn-write / ENOSPC window)",
+    "storage.fsync": "durable-IO seam about to fsync a file or "
+                     "directory (the fsync-EIO window)",
+    "storage.read": "durable-IO seam reading a durable file back "
+                    "(the bit-rot window — damage here is silent "
+                    "unless a checksum catches it)",
+    "storage.rename": "durable-IO seam about to atomically publish "
+                      "via rename (crash-before/after-rename window)",
     "wal.append": "coordination WAL about to frame+write an entry batch "
                   "(failure = write not acknowledged)",
     "wal.fsync": "coordination WAL about to fsync appended entries",

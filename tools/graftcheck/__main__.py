@@ -26,7 +26,7 @@ from tools.graftcheck.core import (BASELINE_PATH, load_allowlist,
                                    load_baseline, run_analyzers, triage)
 
 ANALYZERS = ("lockgraph", "jitpurity", "registry_drift", "resilience",
-             "wallclock", "protocol", "deadsymbols")
+             "wallclock", "protocol", "deadsymbols", "storageseam")
 
 
 def main(argv: list[str] | None = None) -> int:

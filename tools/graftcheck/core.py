@@ -542,7 +542,7 @@ def run_analyzers(root: str, analyzers: list[str] | None = None
     ``root``; returns RAW findings (baseline/allowlist not applied)."""
     from tools.graftcheck import (deadsymbols, jitpurity, lockgraph,
                                   protocol, registry_drift, resilience,
-                                  wallclock)
+                                  storageseam, wallclock)
     tree = SourceTree(root)
     passes = {
         "lockgraph": lockgraph.analyze,
@@ -552,6 +552,7 @@ def run_analyzers(root: str, analyzers: list[str] | None = None
         "wallclock": wallclock.analyze,
         "protocol": lambda t: protocol.analyze(t, root),
         "deadsymbols": lambda t: deadsymbols.analyze(t, root),
+        "storageseam": lambda t: storageseam.analyze(t, root),
     }
     out: list[Finding] = []
     for name, fn in passes.items():

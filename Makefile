@@ -87,6 +87,13 @@
 #                     and 4 stateless routers; admitted interactive
 #                     q/s must scale (2 routers >= 1.6x the 1-router
 #                     baseline); writes BENCH_r07.json
+#   make bench-kernel  r14 kernel-headroom bench: A-build v3 vs v4 vs
+#                     the XLA oracle (parity gated in-run), the
+#                     analytic A-build op-count model, and steady
+#                     commit cost incremental-df vs full-recompute
+#                     across a 4x corpus sweep on the mesh-ELL and
+#                     segments indexes (df_full_recomputes witness
+#                     asserted zero); writes BENCH_r09.json
 
 #   make trace-demo   zero-to-aha for the tracing layer: spin a small
 #                     in-process cluster, kill a worker mid-request,
@@ -121,7 +128,8 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition chaos-autopilot chaos-router \
         chaos-powerloss scrub \
-        faults bench bench-overload bench-routers probe-overlap \
+        faults bench bench-overload bench-routers bench-kernel \
+        probe-overlap \
         graftcheck lockdep protocol-witness check trace-demo
 
 test:
@@ -144,6 +152,7 @@ lockdep:
 	  tests/test_admission.py tests/test_partition.py \
 	  tests/test_observability.py tests/test_autopilot.py \
 	  tests/test_router.py tests/test_storage.py \
+	  tests/test_commit_stats.py \
 	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
@@ -207,3 +216,6 @@ bench-overload:
 
 bench-routers:
 	BENCH_OUT=BENCH_r07.json python bench.py --routers
+
+bench-kernel:
+	BENCH_OUT=BENCH_r09.json python bench.py --kernel

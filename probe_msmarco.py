@@ -97,24 +97,29 @@ def main():
     cm = np.asarray([m for m, _f in steady] or [0.0])
     cm_merge = np.asarray([m for m, f in steady if f] or [0.0])
     cm_alone = np.asarray([m for m, f in steady if not f] or [0.0])
+    # END-OF-RUN SEARCH GATE (ROADMAP item 7 hygiene): a run whose
+    # full-scale search fails must FAIL — loudly, without touching the
+    # committed artifact. MSMARCO_SCALE.json carried an unevidenced
+    # `search_ok: false` from r5 to r13 precisely because this gate
+    # used to record its own failure into the artifact and exit 0; an
+    # artifact that silently documents a broken run is a bench bug
+    # (bench.py --kernel applies the same assert-before-emit
+    # discipline). The tunnel's remote-compile flake still gets one
+    # retry; a second failure aborts the probe with a nonzero exit.
     queries = make_queries(rng, NS_VOCAB, 32)
     try:
-        try:
-            hits = engine.search_batch(queries, k=10)
-        except Exception as e:
-            if "compile" not in repr(e).lower():
-                raise
-            log(f"[st] search compile flake, retrying once: {e!r}")
-            time.sleep(5.0)
-            hits = engine.search_batch(queries, k=10)
-        search_ok = bool(any(hits))
+        hits = engine.search_batch(queries, k=10)
     except Exception as e:
-        # the tunnel's remote-compile service flakes occasionally
-        # (HTTP 500 from tpu_compile_helper); the ingest/commit stats
-        # above are the point of this probe — record the failure
-        # instead of losing the whole run to it
-        log(f"[st] full-scale search failed: {e!r}")
-        search_ok = False
+        if "compile" not in repr(e).lower():
+            raise
+        log(f"[st] search compile flake, retrying once: {e!r}")
+        time.sleep(5.0)
+        hits = engine.search_batch(queries, k=10)
+    if not any(hits):
+        sys.exit("[st] FULL-SCALE SEARCH GATE FAILED: no hits at "
+                 f"{N_DOCS} docs — refusing to emit an artifact for a "
+                 "run that cannot answer queries")
+    search_ok = True
     from tfidf_tpu.utils.metrics import global_metrics
     snap = global_metrics.snapshot()
     out = {

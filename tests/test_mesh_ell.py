@@ -228,7 +228,7 @@ class TestIncrementalStats:
         # the DEVICE-resident replicated df (maintained by journaled
         # sparse scatters between rebuilds) must match the host truth
         snap = e.index.snapshot
-        if snap is not None and not e.index._df_journal:
+        if snap is not None and not e.index._df_delta.journal:
             dev = np.asarray(snap.df_g)
             want, _n, _l = e.index._live_stats(dev.shape[0])
             np.testing.assert_array_equal(dev, want)

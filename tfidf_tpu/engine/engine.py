@@ -96,12 +96,14 @@ class Engine:
                     self.model, mesh=mesh,
                     min_doc_cap=c.min_doc_capacity,
                     min_chunk_cap=min_chunk,
-                    ell_width_cap=c.ell_width_cap)
+                    ell_width_cap=c.ell_width_cap,
+                    incremental_stats=c.df_incremental)
                 self.searcher = MeshEllSearcher(
                     self.index, self.analyzer, self.vocab, self.model,
                     query_batch=c.query_batch,
                     max_query_terms=c.max_query_terms,
                     top_k=c.top_k, result_order=c.result_order,
+                    kernel_a_build=c.kernel_a_build,
                     pipeline_depth=c.search_pipeline_depth,
                     pipeline_mode=c.search_pipeline_mode)
                 return
@@ -128,7 +130,8 @@ class Engine:
                 max_segments=c.max_segments,
                 sync_merge_nnz=c.sync_merge_nnz,
                 merge_upload_pace=c.merge_upload_pace,
-                merge_workers=c.merge_workers)
+                merge_workers=c.merge_workers,
+                incremental_stats=c.df_incremental)
         else:
             self.index = ShardIndex(
                 self.model,
@@ -141,6 +144,7 @@ class Engine:
             query_batch=c.query_batch, max_query_terms=c.max_query_terms,
             top_k=c.top_k, result_order=c.result_order,
             use_pallas=c.use_pallas,
+            kernel_a_build=c.kernel_a_build,
             pipeline_depth=c.search_pipeline_depth,
             pipeline_mode=c.search_pipeline_mode)
 

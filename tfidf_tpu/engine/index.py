@@ -50,6 +50,23 @@ class DocEntry:
     live: bool = True
 
 
+def check_sorted_unique_ids(name: str, ids: np.ndarray) -> None:
+    """Enforce the ``add_document_arrays`` contract — term ids strictly
+    ascending (sorted AND distinct) — at the ingest seam, where it is
+    one vectorized diff per document. Everything downstream assumes it:
+    the ELL layouts store one posting per distinct term, and the v4
+    A-build's pair fold selects AT MOST ONE match per pair, so a
+    duplicated id that slipped in here would score differently on the
+    kernel vs the XLA path (silently, per block). The analyzer, native
+    tokenizer, and dict ingest all produce conforming arrays; this
+    catches the raw-array caller that does not."""
+    if ids.shape[0] > 1 and not (np.diff(ids) > 0).all():
+        raise ValueError(
+            f"add_document_arrays({name!r}): term ids must be strictly "
+            "ascending (sorted, distinct) — merge duplicate ids into "
+            "one entry with the summed tf")
+
+
 def entries_from_packed(names: list[str], offsets: np.ndarray,
                         term_ids: np.ndarray, tfs: np.ndarray,
                         lengths: np.ndarray):
@@ -178,8 +195,10 @@ class ShardIndex:
                             length: float | None = None) -> None:
         """Upsert from pre-sorted id/tf arrays (the native ingest path
         produces these directly — no dict round-trip)."""
+        ids = np.asarray(ids, np.int32)
+        check_sorted_unique_ids(name, ids)
         entry = DocEntry(
-            name=name, term_ids=np.asarray(ids, np.int32),
+            name=name, term_ids=ids,
             tfs=np.asarray(tfs, np.float32),
             length=float(length if length is not None else tfs.sum()))
         with self._write_lock:

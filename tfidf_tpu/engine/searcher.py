@@ -197,6 +197,7 @@ class Searcher(QueryVectorizerMixin):
                  *, query_batch: int = 32, max_query_terms: int = 32,
                  top_k: int = 10, result_order: str = "score",
                  use_pallas: bool = False,
+                 kernel_a_build: str = "v4",
                  pipeline_depth: int = 2,
                  pipeline_mode: str = "auto") -> None:
         self.index = index
@@ -210,6 +211,12 @@ class Searcher(QueryVectorizerMixin):
         # (Leader.java:80-91 sorts the merged map by document name)
         self.result_order = result_order
         self.use_pallas = use_pallas
+        # A-build variant for the fused kernel (ops/ell.py): scores are
+        # bit-identical across variants; the knob exists so a kernel
+        # regression can be isolated live (and benched old-vs-new).
+        # Validated at construction so a typo fails before any query.
+        from tfidf_tpu.ops.ell import check_a_build
+        self.kernel_a_build = check_a_build(kernel_a_build)
         # in-flight chunks: on small corpora the device step is far
         # shorter than the device->host fetch RTT, so serial execution
         # caps throughput at ~1 chunk per RTT; depth D keeps D fetches
@@ -315,6 +322,7 @@ class Searcher(QueryVectorizerMixin):
                     snap.doc_len, snap.df, qb,
                     snap.n_docs, snap.avgdl, snap.doc_norms,
                     use_pallas=self.use_pallas,
+                    a_build=self.kernel_a_build,
                     **self.model.score_kwargs())
             else:
                 scores = score_coo_batch(

@@ -53,6 +53,7 @@ import numpy as np
 from tfidf_tpu.engine.index import DocEntry
 from tfidf_tpu.models.base import ScoringModel
 from tfidf_tpu.ops.csr import CooShard, next_capacity
+from tfidf_tpu.ops.dfdelta import DfDeltaApplier
 from tfidf_tpu.ops.ell import SegmentView, build_ell_from_coo
 from tfidf_tpu.utils.logging import get_logger
 from tfidf_tpu.utils.metrics import global_metrics
@@ -82,6 +83,12 @@ class Segment:
     doc_len_d: jax.Array | None  # f32 [doc_cap] transformed (residual path)
     nnz_total: int = 0    # host postings entries (merge-tier sizing)
     live: np.ndarray = field(default=None)  # bool [n_docs] host mirror
+    # sparse mirror of ``df`` (ids of the nonzero terms + their
+    # counts): the O(segment nnz) currency of the incremental global-
+    # stats path — adding/removing a segment moves df by exactly these
+    # deltas, so commit never rescans the corpus (PERF.md r2 item 3)
+    df_ids: np.ndarray = field(default=None)     # i64 [n_distinct]
+    df_counts: np.ndarray = field(default=None)  # f32 [n_distinct]
     # bumped on every tombstone: keys the per-segment view cache so an
     # untouched segment's scoring view (and its device live mask) is
     # REUSED across commits instead of rebuilt+re-uploaded
@@ -91,6 +98,16 @@ class Segment:
     @property
     def n_docs(self) -> int:
         return len(self.names)
+
+    def sparse_df(self) -> tuple[np.ndarray, np.ndarray]:
+        """(nonzero term ids, counts) — computed once per segment
+        build/restore and cached; O(vocab_cap) to derive, corpus-size-
+        independent."""
+        if self.df_ids is None:
+            ids = np.nonzero(self.df)[0].astype(np.int64)
+            self.df_ids = ids
+            self.df_counts = self.df[ids].astype(np.float32)
+        return self.df_ids, self.df_counts
 
 
 class _PaddedNameResolver:
@@ -198,7 +215,8 @@ class SegmentedIndex:
                  max_segments: int = 8,
                  sync_merge_nnz: int = 1 << 20,
                  merge_upload_pace: float = 1.0,
-                 merge_workers: int = 2) -> None:
+                 merge_workers: int = 2,
+                 incremental_stats: bool = True) -> None:
         self.model = model
         self.min_doc_cap = min_doc_cap
         self.ell_width_cap = ell_width_cap
@@ -243,6 +261,26 @@ class SegmentedIndex:
         # counters move only on mutation
         self._nnz_live_stat = 0
         self._bytes_live_stat = 0
+        # incremental GLOBAL stats (df/N/avgdl — PERF.md r2 item 3):
+        # maintained as deltas on segment append/splice so the commit's
+        # stat pass is O(new-segment nnz), not O(segments x vocab) host
+        # adds + an O(vocab) dense df re-upload per commit. The device
+        # df advances by one journaled sparse scatter; totals INCLUDE
+        # tombstones until merge (Lucene docFreq/docCount semantics,
+        # same as the full recompute below). False = the pre-r14
+        # control path for bench.py --kernel, never the default.
+        self.incremental_stats = incremental_stats
+        self._df_total = np.zeros(0, np.float64)   # tombstone-inclusive
+        self._count_total = 0
+        self._len_total = 0.0
+        self._live_total = 0
+        self._df_delta = DfDeltaApplier()
+        self._df_device = None        # committed [vocab_cap] device df
+        # witness: commits that paid the full O(segments x vocab) stat
+        # recompute (first commit / vocab growth / control path) —
+        # steady-state streaming commits must leave it untouched
+        # (tests/test_commit_stats.py)
+        self.df_full_recomputes = 0
 
     # ---- write path ----
 
@@ -261,9 +299,12 @@ class SegmentedIndex:
     def add_document_arrays(self, name: str, ids: np.ndarray,
                             tfs: np.ndarray,
                             length: float | None = None) -> None:
+        from tfidf_tpu.engine.index import check_sorted_unique_ids
         tfs = np.asarray(tfs, np.float32)
+        ids = np.asarray(ids, np.int32)
+        check_sorted_unique_ids(name, ids)
         entry = DocEntry(
-            name=name, term_ids=np.asarray(ids, np.int32), tfs=tfs,
+            name=name, term_ids=ids, tfs=tfs,
             length=float(length if length is not None else tfs.sum()))
         with self._write_lock:
             self._tombstone_locked(name)
@@ -301,9 +342,38 @@ class SegmentedIndex:
             # uncommitted Lucene delete)
         self._nnz_live_stat -= entry.term_ids.shape[0]
         self._bytes_live_stat -= entry.term_ids.nbytes + entry.tfs.nbytes
+        if seg is not None:
+            # a committed tombstone leaves df/N/avgdl alone (the doc
+            # keeps counting until its segment merges — Lucene
+            # semantics) but the live gauge moves now
+            self._live_total -= 1
         return True
 
     # ---- stats ----
+
+    def _stats_add_segment_locked(self, seg: Segment) -> None:
+        ids, counts = seg.sparse_df()
+        if ids.shape[0]:
+            hi = int(ids[-1]) + 1          # nonzero() ids are sorted
+            if hi > self._df_total.shape[0]:
+                grown = np.zeros(max(hi, 2 * self._df_total.shape[0]),
+                                 np.float64)
+                grown[:self._df_total.shape[0]] = self._df_total
+                self._df_total = grown
+            self._df_total[ids] += counts  # ids unique: plain fancy add
+            self._df_delta.record(ids, counts)
+        self._count_total += seg.n_docs
+        self._len_total += float(seg.raw_len.sum())
+        self._live_total += int(seg.live.sum())
+
+    def _stats_remove_segment_locked(self, seg: Segment) -> None:
+        ids, counts = seg.sparse_df()
+        if ids.shape[0]:
+            self._df_total[ids] -= counts
+            self._df_delta.record(ids, -counts)
+        self._count_total -= seg.n_docs
+        self._len_total -= float(seg.raw_len.sum())
+        self._live_total -= int(seg.live.sum())
 
     def live_names(self) -> list[str]:
         """Names of all live documents (same contract as
@@ -329,6 +399,24 @@ class SegmentedIndex:
             n += sum(d.term_ids.shape[0]
                      for d, alive in zip(seg.host_docs, seg.live) if alive)
         return int(n)
+
+    def _stats_scratch_locked(self, vocab_cap: int):
+        """Full recompute of the global stats (df summed over every
+        segment, tombstone-inclusive doc count and length sum, live
+        count) — the pre-r14 per-commit pass, now the resync belt
+        (first commit, vocab growth, ``incremental_stats=False``) and
+        the test oracle for the incremental accumulators."""
+        df = np.zeros(vocab_cap, np.float32)
+        total_count = 0
+        total_len = 0.0
+        live_count = 0
+        for seg in self._segments:
+            v = min(len(seg.df), vocab_cap)
+            df[:v] += seg.df[:v]
+            total_count += seg.n_docs
+            total_len += float(seg.raw_len.sum())
+            live_count += int(seg.live.sum())
+        return df, total_count, total_len, live_count
 
     def _bytes_live_scratch(self) -> int:
         """Full recompute (test oracle for the incremental counter)."""
@@ -628,7 +716,7 @@ class SegmentedIndex:
                     time.sleep(pace * (time.perf_counter() - u0))
         else:
             res_tf = res_term = res_doc = doc_len_d = None
-        return Segment(
+        seg = Segment(
             tfs=tuple(tfs_d), terms=tuple(terms_d), dls=tuple(dls_d),
             norms0=tuple(norms0),
             block_live=jnp.asarray(np.asarray(rows, np.int32)),
@@ -638,6 +726,8 @@ class SegmentedIndex:
             res_tf=res_tf, res_term=res_term, res_doc=res_doc,
             doc_len_d=doc_len_d, nnz_total=nnz,
             live=np.ones(n, bool))
+        seg.sparse_df()   # populate off the write lock (splice holds it)
+        return seg
 
     def _cosine_norms_real(self, seg: Segment, df_total: np.ndarray,
                            n_total: float) -> np.ndarray:
@@ -715,6 +805,7 @@ class SegmentedIndex:
                     for local, d in enumerate(new_seg.host_docs):
                         self._where[d.name] = (new_seg, local)
                     self._segments.append(new_seg)
+                    self._stats_add_segment_locked(new_seg)
                 if len(self._segments) > self.max_segments:
                     self._merge_policy_locked(vocab_cap)
                 segments = list(self._segments)
@@ -723,19 +814,42 @@ class SegmentedIndex:
                 # doc count/avgdl INCLUDE tombstoned docs until compaction —
                 # Lucene's docFreq and docCount move together the same way;
                 # mixing tombstone-inclusive df with live-only N would push
-                # idf negative for heavily-deleted terms.
-                df_total = np.zeros(vocab_cap, np.float32)
-                total_count = 0
-                total_len = 0.0
-                live_count = 0
-                for seg in segments:
-                    v = min(len(seg.df), vocab_cap)
-                    df_total[:v] += seg.df[:v]
-                    total_count += seg.n_docs
-                    total_len += float(seg.raw_len.sum())
-                    live_count += int(seg.live.sum())
+                # idf negative for heavily-deleted terms. Steady state
+                # reads the incrementally maintained totals and advances
+                # the device df by ONE journaled sparse scatter
+                # (O(new-segment nnz)); only the first commit, vocab
+                # growth, and the incremental_stats=False control path
+                # pay the full O(segments x vocab) recompute + dense df
+                # upload — counted by the df_full_recomputes witness.
+                if (self.incremental_stats
+                        and self._df_device is not None
+                        and self._df_device.shape[0] == vocab_cap):
+                    df_dev = self._df_delta.apply(self._df_device)
+                    total_count = self._count_total
+                    total_len = self._len_total
+                    live_count = self._live_total
+                    df_host = None
+                else:
+                    df_host, total_count, total_len, live_count = \
+                        self._stats_scratch_locked(vocab_cap)
+                    self.df_full_recomputes += 1
+                    # resync the accumulators so the incremental path
+                    # resumes from the authoritative per-segment dfs
+                    self._df_total = df_host.astype(np.float64)
+                    self._count_total = total_count
+                    self._len_total = total_len
+                    self._live_total = live_count
+                    self._df_delta.clear()
+                    df_dev = jnp.asarray(df_host)
+                self._df_device = df_dev
+                if self.model.needs_norms and df_host is None:
+                    # cosine norms read the CURRENT dense df host-side
+                    # (only the cosine model pays this O(vocab) copy)
+                    df_host = np.zeros(vocab_cap, np.float32)
+                    v = min(self._df_total.shape[0], vocab_cap)
+                    df_host[:v] = self._df_total[:v]
                 v0 = time.perf_counter()
-                views = tuple(self._make_view(seg, df_total,
+                views = tuple(self._make_view(seg, df_host,
                                               float(total_count))
                               for seg in segments)
                 view_s = time.perf_counter() - v0
@@ -743,7 +857,7 @@ class SegmentedIndex:
                 snap = SegmentedSnapshot(
                     segments=segments,
                     views=views,
-                    df=jnp.asarray(df_total),
+                    df=df_dev,
                     n_docs=jnp.float32(total_count),
                     avgdl=jnp.float32(
                         total_len / total_count if total_count else 1.0),
@@ -842,6 +956,13 @@ class SegmentedIndex:
                     # (the merged segment has no cached view yet, but
                     # the cache key must never go stale by construction)
                     merged.live_version += 1
+        # global stats move by the splice's exact deltas (merge
+        # reclaims tombstones from df/N/avgdl, as the full recompute
+        # would see) — O(merge nnz), amortized by the merge itself
+        for s in sources:
+            self._stats_remove_segment_locked(s)
+        if merged is not None:
+            self._stats_add_segment_locked(merged)
         global_metrics.inc("compactions")
 
     def _merge_inline_locked(self, sources: list[Segment],

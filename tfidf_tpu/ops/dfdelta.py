@@ -1,0 +1,99 @@
+"""Sparse on-device df maintenance — the O(batch) commit primitive.
+
+Global document frequency is a [vocab_cap] device array replicated to
+every scoring step. Recomputing it host-side per commit is O(corpus
+nnz) (the round-2 headroom item PERF.md re-affirmed every round since),
+and re-uploading the dense array per commit is O(vocab) transfer (~2MB
+at 500k terms — the dominant steady-commit cost on high-latency
+links). Lucene never rescans: each segment carries its own df and the
+collection stats move by deltas. This module is that discipline for
+the device-resident df:
+
+* mutations journal ``(term_ids, delta)`` pairs — O(1) bookkeeping per
+  mutation, O(batch nnz) total per commit;
+* commit folds the whole journal into the previous committed df with
+  ONE padded sparse scatter-add (pad indices point out of bounds and
+  drop), compiled once per power-of-two update capacity;
+* df counts are integer-valued f32 adds — exact while below 2^24, so
+  the incremental path is bit-equal to a full recompute (the parity
+  contract ``tests/test_commit_stats.py`` pins after randomized
+  upsert/delete/merge sequences); full resyncs (first commit, vocab
+  growth, restore) go around the journal entirely.
+
+Shared by :class:`~tfidf_tpu.parallel.mesh_ell_index.MeshEllIndex`
+(replicated mesh df) and :class:`~tfidf_tpu.engine.segments
+.SegmentedIndex` (single-device df): one implementation, two witnesses.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from tfidf_tpu.ops.csr import next_capacity
+
+
+class DfDeltaApplier:
+    """Journaled sparse updates to a device-resident df array.
+
+    ``out_sharding`` (optional ``NamedSharding``) keeps the updated
+    array replicated on a mesh; None leaves placement to the default
+    single-device semantics.
+    """
+
+    def __init__(self, out_sharding=None, min_cap: int = 256) -> None:
+        self._out_sharding = out_sharding
+        self._min_cap = min_cap
+        self._fns: dict[int, object] = {}
+        self.journal: list[tuple[np.ndarray, object]] = []
+
+    def record(self, ids: np.ndarray, delta) -> None:
+        """Journal a df change: ``delta`` is a scalar applied to every
+        id (upsert/delete: +1/-1 per distinct term) or a per-id array
+        (segment append/splice: the segment's sparse df counts)."""
+        if ids.shape[0]:
+            self.journal.append((ids, delta))
+
+    def clear(self) -> None:
+        self.journal = []
+
+    def _coalesced(self) -> tuple[np.ndarray, np.ndarray] | None:
+        """(unique ids, net f32 deltas) over the journal; None if the
+        journal nets out to nothing."""
+        if not self.journal:
+            return None
+        allids = np.concatenate([ids for ids, _d in self.journal])
+        deltas = np.concatenate(
+            [np.broadcast_to(np.asarray(d, np.float32), ids.shape)
+             for ids, d in self.journal])
+        uniq, inv = np.unique(allids, return_inverse=True)
+        dv = np.bincount(inv, weights=deltas).astype(np.float32)
+        nz = dv != 0
+        uniq, dv = uniq[nz], dv[nz]
+        if uniq.shape[0] == 0:
+            return None
+        return uniq.astype(np.int64), dv
+
+    def apply(self, df_g: jax.Array) -> jax.Array:
+        """Fold the journal into ``df_g`` with one padded scatter-add
+        and clear it. Functionally pure on the device array: callers
+        holding an older snapshot keep their unmodified df."""
+        coalesced = self._coalesced()
+        self.journal = []
+        if coalesced is None:
+            return df_g
+        uniq, dv = coalesced
+        cap = next_capacity(int(uniq.shape[0]), self._min_cap)
+        idx = np.full(cap, df_g.shape[0], np.int32)   # pads drop
+        vals = np.zeros(cap, np.float32)
+        idx[:uniq.shape[0]] = uniq
+        vals[:uniq.shape[0]] = dv
+        fn = self._fns.get(cap)
+        if fn is None:
+            kw = {}
+            if self._out_sharding is not None:
+                kw["out_shardings"] = self._out_sharding
+            fn = jax.jit(
+                lambda df, i, v: df.at[i].add(v, mode="drop"), **kw)
+            self._fns[cap] = fn
+        return fn(df_g, idx, vals)

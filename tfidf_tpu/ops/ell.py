@@ -212,9 +212,51 @@ ell_impacts = jax.jit(ell_impacts, static_argnames=("model", "k1", "b"))
 # ``n_uniq`` arrives by scalar prefetch so tiles past the batch's live
 # unique terms are SKIPPED — work scales with the actual unique count,
 # not the padded capacity, and arbitrarily large u_cap costs nothing.
+#
+# A-build variants (``a_build``, PERF.md r2 item 2 — the remaining
+# kernel headroom after the r3 uniq-tiling):
+#
+# * ``"v3"`` — one width row per loop iteration: per padded entry per
+#   uniq lane the A-build costs 1 compare + 1 select + 1 accumulate
+#   add, all on i32/f32 vregs (3 vreg-ops/entry).
+# * ``"v4"`` — TWO width rows per iteration. Within one document row
+#   the live term ids are DISTINCT (the ELL layout stores one posting
+#   per distinct term; pads are trailing and carry impact 0), so at
+#   most one compare of a (w, w+1) pair can select a non-zero impact:
+#   the pair folds into ONE nested select chain and ONE accumulate add
+#   — the loop-carried add chain halves (width/2 deep instead of
+#   width), and because +0.0 is exact in f32 the result is
+#   BIT-IDENTICAL to v3. Where every term id fits in 15 bits
+#   (vocab_cap <= 2^15) the packed-compare sub-variant additionally
+#   casts term ids and uniq ids to i16 — Mosaic packs i16 two per
+#   32-bit lane (16x128 vreg vs 8x128 for i32), halving the compare
+#   vreg cost and the term tile's VMEM/HBM bytes. Cost per 2 entries:
+#   2 cmp (1 vreg-op packed) + 2 sel + 1 add = 2.0 vreg-ops/entry
+#   packed, 2.5 unpacked, vs v3's 3.0 (the op-count model bench.py
+#   --kernel emits into BENCH_r09.json).
+#
+# The XLA reduce-fusion path (``_score_block``) stays untouched as the
+# oracle for both.
 
 _PL_TD = 512          # docs per grid tile (256 for small blocks)
 _PL_MAX_B = 2048      # VMEM: qc [B, TU] + out [B, TD] stay ~8MB
+# term ids below this bound compare as packed i16 in the v4 A-build
+# (two ids per 32-bit lane); -1 (the uniq pad sentinel) still fits
+_PACKED_VOCAB_MAX = 1 << 15
+A_BUILD_VARIANTS = ("v3", "v4")
+
+
+def check_a_build(a_build: str) -> str:
+    """The ONE validator for the kernel_a_build knob (searchers call it
+    at construction, the kernel entry points at trace time): an unknown
+    variant must fail loudly everywhere — quietly failing eligibility
+    would silently route every block to the slow XLA path on a config
+    typo."""
+    if a_build not in A_BUILD_VARIANTS:
+        raise ValueError(
+            f"kernel_a_build={a_build!r}: expected one of "
+            f"{A_BUILD_VARIANTS}")
+    return a_build
 
 
 def _pallas_kernel(lims_ref, uniq_ref, qc_ref, term_ref, imp_ref,
@@ -252,14 +294,68 @@ def _pallas_kernel(lims_ref, uniq_ref, qc_ref, term_ref, imp_ref,
                               precision=jax.lax.Precision.HIGHEST)
 
 
-def _pl_tiles(rows_cap: int, B: int, u_cap: int) -> tuple[int, int]:
+def _pallas_kernel_v4(lims_ref, uniq_ref, qc_ref, term_ref, imp_ref,
+                      out_ref, *, width: int, td: int, tu: int):
+    """A-build v4: two width rows per iteration (see the variant notes
+    above). CONTRACT: within a document row the live term ids are
+    distinct and pads (impact 0) are trailing — both guaranteed by
+    every ELL builder in this tree (``build_ell_from_coo`` lays out one
+    entry per distinct term left-to-right; ``build_mesh_ell`` fills
+    ``e.term_ids``, distinct by construction, and the terms-axis width
+    shard is a contiguous column slice, so pads stay trailing). A row
+    violating it would double-select where v3 double-adds."""
+    d = pl.program_id(0)
+    u = pl.program_id(1)
+
+    @pl.when(u == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(jnp.logical_and(u * tu < lims_ref[0],
+                             d * td < lims_ref[1]))
+    def _tile():
+        uniq_col = uniq_ref[:]                       # [TU, 1] i32|i16
+
+        def pair(w, a):                              # a [TU, Td]
+            t0 = term_ref[w, :][None, :]             # [1, Td]
+            t1 = term_ref[w + 1, :][None, :]
+            i0 = imp_ref[w, :][None, :]
+            i1 = imp_ref[w + 1, :][None, :]
+            # at most one branch selects non-zero (distinct live ids;
+            # a pad match selects its 0.0 impact) — one add per pair,
+            # bit-identical to v3's add-of-0.0 for the missed branch
+            return a + jnp.where(uniq_col == t0, i0,
+                                 jnp.where(uniq_col == t1, i1, 0.0))
+
+        def pair_at(p, a):
+            return pair(2 * p, a)
+
+        a = jax.lax.fori_loop(0, width // 2, pair_at,
+                              jnp.zeros((tu, td), jnp.float32))
+        if width % 2:                                # static tail row
+            t = term_ref[width - 1, :][None, :]
+            i = imp_ref[width - 1, :][None, :]
+            a = a + jnp.where(uniq_col == t, i, 0.0)
+        out_ref[:] += jnp.dot(qc_ref[:], a,
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)
+
+
+def _pl_tiles(rows_cap: int, B: int, u_cap: int,
+              a_build: str = "v3") -> tuple[int, int]:
     """(doc tile, uniq tile) for a block/batch shape. Bigger tiles
     amortize grid overhead; both tiles shrink as B grows so the
     multi-buffered qc [B, TU] / out [B, TD] blocks plus the A
     accumulator and MXU temporaries stay inside the 16MB scoped-VMEM
     budget (measured: Mosaic's buffering costs ~2x the naive block
-    arithmetic, so the schedule is deliberately conservative)."""
-    cap = 512 if B <= 512 else (256 if B <= 1024 else 128)
+    arithmetic, so the schedule is deliberately conservative). v4 gets
+    its own schedule: the pair loop holds half the loop temporaries
+    and (packed) an i16 term tile at half the bytes, so it keeps the
+    512 tile cap up to B=1024 where v3 already drops to 256."""
+    if a_build == "v4":
+        cap = 512 if B <= 1024 else 256
+    else:
+        cap = 512 if B <= 512 else (256 if B <= 1024 else 128)
     td = min(cap, _PL_TD if rows_cap % _PL_TD == 0 else _PL_TD // 2)
     tu = min(cap, 512 if u_cap % 512 == 0 else 256, u_cap)
     return td, tu
@@ -271,19 +367,27 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
                        n_uniq: jax.Array,    # i32 scalar (traced)
                        qc_ext: jax.Array,    # f32 [B, U_cap+1]
                        n_rows: jax.Array | None = None,  # i32 scalar
-                       ) -> jax.Array:
+                       *, a_build: str = "v3",
+                       vocab_cap: int = 0) -> jax.Array:
     """Fused ELL-block scoring on TPU: ``[B, rows_cap]`` scores.
 
     ``n_rows`` (traced) is the block's live row count: doc tiles wholly
     past it skip the A-build and contraction (their scores are zeroed by
     the unconditional init, exactly what all-pad rows would score).
+
+    ``a_build`` selects the A-build variant (see the notes above);
+    ``vocab_cap`` (static; 0 = unknown) arms the v4 packed-compare
+    sub-variant when every term id fits in i16. Both variants are
+    bit-identical to each other; the XLA reduce-fusion path is the
+    oracle (``kernel_parity.py``).
     """
     import functools
 
+    check_a_build(a_build)
     rows_cap, width = impact.shape
     B, _ = qc_ext.shape
     u_cap = uniq.shape[0]
-    td, tu = _pl_tiles(rows_cap, B, u_cap)
+    td, tu = _pl_tiles(rows_cap, B, u_cap, a_build)
     # the grid floor-divides: a non-multiple capacity would silently
     # drop the trailing tile (callers route through _pallas_eligible,
     # but direct callers must fail loudly, not score wrong)
@@ -295,12 +399,19 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
     qc = qc_ext[:, :u_cap]                           # drop the zero column
     imp_t = impact.T                                 # [W, rows] width-major
     term_t = term.T
+    packed = (a_build == "v4" and 0 < vocab_cap <= _PACKED_VOCAB_MAX)
+    if packed:
+        # ids (and the -1 pad sentinel) fit i16: the compare runs at
+        # two lanes per 32-bit vreg lane, and the term tile halves
+        uniq_col = uniq_col.astype(jnp.int16)
+        term_t = term_t.astype(jnp.int16)
     if n_rows is None:
         n_rows = jnp.int32(rows_cap)
     lims = jnp.stack([jnp.asarray(n_uniq, jnp.int32),
                       jnp.asarray(n_rows, jnp.int32)])
 
-    kernel = functools.partial(_pallas_kernel, width=width, td=td, tu=tu)
+    kern = _pallas_kernel_v4 if a_build == "v4" else _pallas_kernel
+    kernel = functools.partial(kern, width=width, td=td, tu=tu)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         # u is the INNER axis: the output block for a doc tile stays in
@@ -327,10 +438,18 @@ def score_block_pallas(impact: jax.Array,    # f32 [rows_cap, width]
     )(lims, uniq_col, qc, term_t, imp_t)
 
 
-def _pallas_eligible(rows_cap: int, B: int, u_cap: int) -> bool:
+def _pallas_eligible(rows_cap: int, B: int, u_cap: int,
+                     a_build: str = "v3") -> bool:
     """Big blocks only — small blocks stay on the XLA path where they
     are cheap. u_cap is unbounded (uniq tiles past ``n_uniq`` are
-    skipped, so capacity padding is free); B is VMEM-bounded."""
+    skipped, so capacity padding is free); B is VMEM-bounded. The
+    envelope is shared by both A-build variants (v4's odd-width tail
+    row and packed sub-variant change the schedule, not the shapes the
+    kernel accepts), so a config flip can never silently change WHICH
+    blocks ride the kernel — only how the A is built. An UNKNOWN
+    variant raises (``check_a_build``) rather than quietly failing
+    eligibility."""
+    check_a_build(a_build)
     return (rows_cap % (_PL_TD // 2) == 0 and rows_cap >= _PL_TD // 2
             and B <= _PL_MAX_B and u_cap % 256 == 0)
 
@@ -410,7 +529,8 @@ def score_ell_impl(impacts,            # tuple of f32 [rows_cap_i, width_i]
                    q: QueryBatch,
                    vocab_cap: int,
                    *, doc_chunk: int = 2048,
-                   use_pallas: bool = False) -> jax.Array:
+                   use_pallas: bool = False,
+                   a_build: str = "v3") -> jax.Array:
     """Gather-based scoring over all blocks: ``scores [B, doc_cap]``.
 
     Blocks are scored in their padded row space ``[B, sum(rows_cap_i)]``
@@ -419,14 +539,17 @@ def score_ell_impl(impacts,            # tuple of f32 [rows_cap_i, width_i]
     same capacity buckets reuses the executable — only the (static) block
     shapes key the compile cache. ``use_pallas`` routes big blocks
     through the fused compare/MXU kernel; the rest stay on the XLA path.
+    ``a_build`` picks the kernel's A-build variant.
     """
     B = q.slots.shape[0]
     slot_of, qc_ext = _compile_queries(q, vocab_cap)
     qc_t = qc_ext.T                                   # [U_cap+1, B]
     u_cap = q.uniq.shape[0]
     parts = [score_block_pallas(imp, term, q.uniq, q.n_uniq, qc_ext,
-                                block_live[i])
-             if use_pallas and _pallas_eligible(imp.shape[0], B, u_cap)
+                                block_live[i], a_build=a_build,
+                                vocab_cap=vocab_cap)
+             if use_pallas and _pallas_eligible(imp.shape[0], B, u_cap,
+                                                a_build)
              else _score_block(imp, term, slot_of, qc_t, doc_chunk)
              for i, (imp, term) in enumerate(zip(impacts, terms))]
     return _rearrange_to_real(parts, [imp.shape[0] for imp in impacts],
@@ -440,7 +563,8 @@ def score_ell_with_residual(impacts, terms, block_live,
                             *, model: str = "bm25", k1: float = 1.2,
                             b: float = 0.75, doc_chunk: int = 2048,
                             res_chunk: int = 1 << 10,
-                            use_pallas: bool = False) -> jax.Array:
+                            use_pallas: bool = False,
+                            a_build: str = "v3") -> jax.Array:
     """Full shard scores: blocked ELL + COO residual (overlong docs).
 
     Pass ``res_tf=None`` when nothing spilled — the residual pass is
@@ -450,7 +574,7 @@ def score_ell_with_residual(impacts, terms, block_live,
     vocab_cap = df.shape[0]
     scores = score_ell_impl(impacts, terms, block_live, doc_cap,
                             q, vocab_cap, doc_chunk=doc_chunk,
-                            use_pallas=use_pallas)
+                            use_pallas=use_pallas, a_build=a_build)
     if res_tf is not None:
         scores = scores + score_coo_impl(
             res_tf, res_term, res_doc, doc_len, df, q,
@@ -462,7 +586,7 @@ def score_ell_with_residual(impacts, terms, block_live,
 score_ell_batch = jax.jit(
     score_ell_with_residual,
     static_argnames=("model", "k1", "b", "doc_chunk", "res_chunk",
-                     "use_pallas"))
+                     "use_pallas", "a_build"))
 
 
 def _score_block_tf(tf: jax.Array, term: jax.Array, dl: jax.Array,

@@ -273,6 +273,7 @@ def make_mesh_ell_search(mesh: Mesh,
                          k1: float = 1.2,
                          b: float = 0.75,
                          use_pallas: bool = True,
+                         a_build: str = "v4",
                          packed: bool = False):
     """Distributed search over ELL base + COO delta.
 
@@ -323,9 +324,11 @@ def make_mesh_ell_search(mesh: Mesh,
         # --- ELL base: same per-block scorers as single-device ---
         parts = []
         for i, (imp, term) in enumerate(zip(impacts, terms)):
-            if use_pallas and _pallas_eligible(imp.shape[0], B, u_cap):
+            if use_pallas and _pallas_eligible(imp.shape[0], B, u_cap,
+                                               a_build):
                 parts.append(score_block_pallas(
-                    imp, term, q.uniq, q.n_uniq, qc_ext, block_live[i]))
+                    imp, term, q.uniq, q.n_uniq, qc_ext, block_live[i],
+                    a_build=a_build, vocab_cap=vocab_cap))
             else:
                 parts.append(_score_block(imp, term, slot_of, qc_t, 2048))
         ell_scores = _rearrange_to_real(

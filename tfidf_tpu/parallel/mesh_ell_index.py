@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tfidf_tpu.ops.csr import CooShard, next_capacity
+from tfidf_tpu.ops.dfdelta import DfDeltaApplier
 from tfidf_tpu.parallel.mesh_ell import (MeshEllArrays, build_mesh_ell,
                                          make_impact_refresh,
                                          make_mesh_ell_search,
@@ -83,13 +84,19 @@ class MeshEllIndex(MeshIndex):
     def __init__(self, model, mesh=None, min_doc_cap: int = 1024,
                  min_chunk_cap: int = 1 << 14,
                  ell_width_cap: int = 256,
-                 delta_rebuild_frac: float = 0.5) -> None:
+                 delta_rebuild_frac: float = 0.5,
+                 incremental_stats: bool = True) -> None:
         super().__init__(model, mesh=mesh, min_doc_cap=min_doc_cap,
                          min_chunk_cap=min_chunk_cap)
         self.ell_width_cap = ell_width_cap
         # fold the delta into the base when it exceeds this fraction of
         # the corpus (the merge policy)
         self.delta_rebuild_frac = delta_rebuild_frac
+        # False = the pre-incremental control path: every commit
+        # recomputes df/N/avgdl from the live host postings (O(corpus
+        # nnz)) and re-uploads the dense df — kept as the bench.py
+        # --kernel old-vs-new lever, never the default
+        self.incremental_stats = incremental_stats
         self._base: MeshEllArrays | None = None
         self._perms: list[np.ndarray] = []
         self._base_counts: list[int] = []
@@ -99,13 +106,23 @@ class MeshEllIndex(MeshIndex):
         self._df_live = np.zeros(0, np.float64)
         self._n_live_stat = 0
         self._len_sum_stat = 0.0
-        # journal of df changes since the last commit: (term_ids, sign)
-        # pairs, O(1) per mutation — the commit applies them as ONE
-        # sparse on-device scatter into the replicated df instead of
-        # re-uploading the whole [vocab_cap] array (2MB at 500k terms,
-        # the dominant steady-commit cost on high-latency links)
-        self._df_journal: list[tuple[np.ndarray, float]] = []
-        self._df_update_fns: dict[int, object] = {}
+        # journal of df changes since the last commit, O(1) per
+        # mutation — the commit applies them as ONE sparse on-device
+        # scatter into the replicated df instead of re-uploading the
+        # whole [vocab_cap] array (2MB at 500k terms, the dominant
+        # steady-commit cost on high-latency links)
+        self._df_delta = DfDeltaApplier(
+            NamedSharding(self.mesh, P(None)))
+        # witness: commits that paid the O(corpus nnz) host stat
+        # recompute (rebuild resync / vocab growth / the control path).
+        # Steady-state append/delete commits must leave it untouched —
+        # tests/test_commit_stats.py pins that.
+        self.df_full_recomputes = 0
+        # append traffic observed (attempted, not just succeeded —
+        # a first burst bigger than the floor delta overflows BEFORE
+        # any append succeeds): gates `_empty_delta`'s threshold
+        # sizing so read-mostly indexes never reserve delta HBM
+        self._append_attempts = 0
 
     # ---- incremental stats bookkeeping ----
 
@@ -119,7 +136,7 @@ class MeshEllIndex(MeshIndex):
                 grown[:self._df_live.shape[0]] = self._df_live
                 self._df_live = grown
             np.add.at(self._df_live, ids, 1.0)
-            self._df_journal.append((ids, 1.0))
+            self._df_delta.record(ids, 1.0)
         self._n_live_stat += 1
         self._len_sum_stat += entry.length
 
@@ -127,15 +144,18 @@ class MeshEllIndex(MeshIndex):
         ids = entry.term_ids
         if ids.shape[0]:
             np.add.at(self._df_live, ids, -1.0)
-            self._df_journal.append((ids, -1.0))
+            self._df_delta.record(ids, -1.0)
         self._n_live_stat -= 1
         self._len_sum_stat -= entry.length
 
     def add_document_arrays(self, name, ids, tfs, length=None):
-        from tfidf_tpu.engine.index import DocEntry
+        from tfidf_tpu.engine.index import (DocEntry,
+                                            check_sorted_unique_ids)
         tfs = np.asarray(tfs, np.float32)
+        ids = np.asarray(ids, np.int32)
+        check_sorted_unique_ids(name, ids)
         entry = DocEntry(
-            name=name, term_ids=np.asarray(ids, np.int32), tfs=tfs,
+            name=name, term_ids=ids, tfs=tfs,
             length=float(length if length is not None else tfs.sum()))
         with self._write_lock:
             old = self._pending.get(name)
@@ -165,7 +185,8 @@ class MeshEllIndex(MeshIndex):
         self._n_live_stat = int(lengths.shape[0])
         self._len_sum_stat = float(np.asarray(lengths,
                                               np.float64).sum())
-        self._df_journal = [(term_ids, 1.0)]
+        self._df_delta.clear()
+        self._df_delta.record(term_ids, 1.0)
 
     def delete_document(self, name: str) -> bool:
         with self._write_lock:
@@ -216,15 +237,24 @@ class MeshEllIndex(MeshIndex):
             # goes stale). After a rebuild the replicated df is uploaded
             # whole; otherwise the journaled changes land as one sparse
             # on-device scatter (O(touched terms), not O(vocab)).
-            if need_rebuild or self.snapshot is None:
+            if not self.incremental_stats:
+                # control path: full O(corpus nnz) recompute + dense
+                # re-upload every commit (the pre-r14 cost model)
+                df_host, n_live, len_sum = self._live_stats_scratch(
+                    vocab_cap, include_pending=False)
+                self.df_full_recomputes += 1
+                df_g = jax.device_put(
+                    df_host, NamedSharding(self.mesh, P(None)))
+                self._df_delta.clear()
+            elif need_rebuild or self.snapshot is None:
                 df_host, n_live, len_sum = self._live_stats(vocab_cap)
                 df_g = jax.device_put(
                     df_host, NamedSharding(self.mesh, P(None)))
+                self._df_delta.clear()
             else:
-                df_g = self._df_apply_journal(self.snapshot.df_g)
+                df_g = self._df_delta.apply(self.snapshot.df_g)
                 n_live = self._n_live_stat
                 len_sum = self._len_sum_stat
-            self._df_journal = []
             n_docs = jnp.float32(n_live)
             avgdl = jnp.float32(len_sum / n_live if n_live else 1.0)
             if self._refresh_fn is None:
@@ -266,36 +296,6 @@ class MeshEllIndex(MeshIndex):
         delta_docs = (len(self._placed) + len(pending)) - base_docs
         return (base_docs == 0
                 or delta_docs > self.delta_rebuild_frac * base_docs)
-
-    def _df_apply_journal(self, df_g):
-        """Fold the journaled df changes into the device-resident
-        replicated df with one padded sparse scatter (pad indices point
-        out of bounds and drop). Counts are integer-valued f32 adds —
-        exact; rebuilds resync from the host accumulators as a belt."""
-        if not self._df_journal:
-            return df_g
-        allids = np.concatenate([ids for ids, _ in self._df_journal])
-        signs = np.concatenate(
-            [np.full(ids.shape[0], s, np.float32)
-             for ids, s in self._df_journal])
-        uniq, inv = np.unique(allids, return_inverse=True)
-        dv = np.bincount(inv, weights=signs).astype(np.float32)
-        nz = dv != 0
-        uniq, dv = uniq[nz], dv[nz]
-        if uniq.shape[0] == 0:
-            return df_g
-        cap = next_capacity(int(uniq.shape[0]), 256)
-        idx = np.full(cap, df_g.shape[0], np.int32)
-        vals = np.zeros(cap, np.float32)
-        idx[:uniq.shape[0]] = uniq
-        vals[:uniq.shape[0]] = dv
-        fn = self._df_update_fns.get(cap)
-        if fn is None:
-            fn = jax.jit(
-                lambda df, i, v: df.at[i].add(v, mode="drop"),
-                out_shardings=NamedSharding(self.mesh, P(None)))
-            self._df_update_fns[cap] = fn
-        return fn(df_g, idx, vals)
 
     def _live_stats(self, vocab_cap: int):
         """O(vocab) snapshot of the incrementally-maintained live stats
@@ -363,7 +363,9 @@ class MeshEllIndex(MeshIndex):
         self._base_counts = [len(p) for p in per_shard]
         self._mask_dirty = False
         # resync the incremental stats from the authoritative postings
-        # (pending was just merged into the shard lists above)
+        # (pending was just merged into the shard lists above) — the
+        # one O(corpus nnz) pass steady commits never take (witness)
+        self.df_full_recomputes += 1
         df, n, len_sum = self._live_stats_scratch(
             max(vocab_cap, self._df_live.shape[0], 1),
             include_pending=False)
@@ -374,19 +376,50 @@ class MeshEllIndex(MeshIndex):
         global_metrics.inc("mesh_reshards")
 
     def _empty_delta(self, vocab_cap: int) -> ShardedArrays:
+        """Fresh COO delta. For an index that has OBSERVED appends, it
+        is sized to cover the MERGE POLICY's fold threshold
+        (delta_rebuild_frac x the base corpus): before r14 the delta
+        was floored at 256 docs/shard regardless of corpus size, so
+        sustained append streams hit CAPACITY overflow — an unplanned
+        O(corpus) rebuild — every ~256 docs/shard, long before the
+        planned fold; steady-state commits were only nominally
+        O(batch). Threshold sizing means the planned `_delta_too_big`
+        fold is what ends a delta's life, and every commit in between
+        is a pure O(batch) device append + sparse df scatter. HBM
+        cost: the delta's COO arrays scale with delta_rebuild_frac x
+        corpus nnz (~12B/entry across the terms axis) — so a
+        READ-MOSTLY index (appends == 0 so far: bulk-load-and-serve)
+        keeps the small floor delta and reserves nothing; the first
+        append burst pays ONE amortized overflow rebuild to promote to
+        threshold sizing."""
+        min_doc = min(256, self.min_doc_cap)
+        min_chunk = self.min_chunk_cap
+        if self._append_attempts:
+            base_docs = sum(self._base_counts)
+            per_shard_docs = -(-int(base_docs * self.delta_rebuild_frac)
+                               // max(self.D, 1))
+            per_slice_nnz = -(-int(self.nnz_live
+                                   * self.delta_rebuild_frac)
+                              // max(self.D * self.T, 1))
+            min_doc = max(min_doc,
+                          next_capacity(per_shard_docs + 1, min_doc))
+            min_chunk = max(min_chunk,
+                            next_capacity(max(per_slice_nnz, 1),
+                                          1 << 10))
         coo = CooShard(
             tf=np.zeros(0, np.float32), term=np.zeros(0, np.int32),
             doc=np.zeros(0, np.int32),
             doc_len=np.zeros(0, np.float32),
             df=np.zeros(vocab_cap, np.float32), nnz=0, num_docs=0)
         return build_sharded_arrays(
-            coo, self.mesh, min_chunk_cap=self.min_chunk_cap,
-            min_doc_cap=min(256, self.min_doc_cap))
+            coo, self.mesh, min_chunk_cap=min_chunk,
+            min_doc_cap=min_doc)
 
     def _append_locked(self, delta: ShardedArrays,
                        pending) -> ShardedArrays:
         """Append into the COO delta. Placement slots continue after the
         base: insertion-local id = base_count + delta slot."""
+        self._append_attempts += 1
         # reuse the parent's machinery; it reads/updates _shard_docs and
         # _placed with insertion-local ids, and build_ingest_batch's
         # local ids continue from delta.n_live — these agree because
@@ -475,6 +508,7 @@ class MeshEllSearcher(MeshSearcher):
             fn = make_mesh_ell_search(
                 self.index.mesh, k=k,
                 model=self.model.score_kwargs()["model"],
+                a_build=self.kernel_a_build,
                 packed=True, **self._model_kwargs())
             self._search_fns[k] = fn
         return fn

@@ -122,9 +122,12 @@ class MeshIndex:
     def add_document_arrays(self, name: str, ids: np.ndarray,
                             tfs: np.ndarray,
                             length: float | None = None) -> None:
+        from tfidf_tpu.engine.index import check_sorted_unique_ids
         tfs = np.asarray(tfs, np.float32)
+        ids = np.asarray(ids, np.int32)
+        check_sorted_unique_ids(name, ids)
         entry = DocEntry(
-            name=name, term_ids=np.asarray(ids, np.int32), tfs=tfs,
+            name=name, term_ids=ids, tfs=tfs,
             length=float(length if length is not None else tfs.sum()))
         with self._write_lock:
             placed = self._placed.pop(name, None)
@@ -371,6 +374,7 @@ class MeshSearcher(QueryVectorizerMixin):
                  *, query_batch: int = 32, max_query_terms: int = 32,
                  top_k: int = 10, result_order: str = "score",
                  global_idf: bool = True,
+                 kernel_a_build: str = "v4",
                  pipeline_depth: int = 2,
                  pipeline_mode: str = "auto") -> None:
         self.index = index
@@ -384,6 +388,11 @@ class MeshSearcher(QueryVectorizerMixin):
         self.pipeline_depth = max(1, pipeline_depth)
         # "auto" | "executor" | "inline" — see QueryVectorizerMixin
         self.pipeline_mode = pipeline_mode
+        # A-build variant for the fused kernel (ELL layout only; the
+        # COO scatter step never touches it). Validated at
+        # construction so a config typo fails before any query.
+        from tfidf_tpu.ops.ell import check_a_build
+        self.kernel_a_build = check_a_build(kernel_a_build)
         # global_idf=False reproduces the reference's per-worker statistics
         # (each Lucene shard scores against local df/N, Worker.java:222-241)
         self.global_idf = global_idf

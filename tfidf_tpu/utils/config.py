@@ -159,6 +159,20 @@ class Config:
     # path's [rows, width, B] HBM materialization — the gather-bound
     # bottleneck at 1M-doc scale). Small blocks always use the XLA path.
     use_pallas: bool = True
+    # A-build variant inside the fused kernel (ops/ell.py): "v4"
+    # processes two width rows per grid iteration (one accumulate add
+    # per pair; i16 packed compares where the vocabulary fits 2^15) —
+    # bit-identical scores to "v3", roughly 2/3 the A-build vreg-ops
+    # (cost model in BENCH_r09.json; parity matrix in
+    # kernel_parity.py). "v3" is the r2-r13 single-row build.
+    kernel_a_build: str = "v4"
+    # Maintain global df/N/avgdl incrementally on mutation so
+    # steady-state commits are O(batch nnz) with the device df advanced
+    # by one sparse scatter (segments + mesh-ELL indexes; the
+    # df_full_recomputes witness counts the exceptional full passes).
+    # False = recompute from the live corpus every commit (the pre-r14
+    # control path, kept for bench.py --kernel old-vs-new runs).
+    df_incremental: bool = True
 
     # --- index mode ---
     # "rebuild": every commit re-lays-out the whole corpus (static corpora)

@@ -71,6 +71,17 @@
 #                     exact single-node-oracle parity, zero acked-write
 #                     loss, zero stale-epoch writes accepted
 #                     (tests/test_partition.py -m slow)
+#   make chaos-upgrade  slow zero-downtime-fleet-evolution chaos job:
+#                     a rolling restart of the WHOLE fleet (workers ->
+#                     router -> leader) onto a raised proto floor under
+#                     live zipfian read + write load, with the version-
+#                     skew nemesis stripping X-Proto-Version on one
+#                     link, a partition, and an fsync-EIO storage fault
+#                     mid-roll — zero acked-write loss, bounded shed,
+#                     zero proto rejections for stamped clients, exact
+#                     single-node-oracle parity at the end, and the
+#                     upgraded fleet 426-rejects unstamped (implicit-v1)
+#                     traffic (tests/test_upgrade.py -m slow)
 #   make faults       list every registered fault point (chaos configs
 #                     should be validated against this — see
 #                     utils/faults.py)
@@ -94,6 +105,13 @@
 #                     across a 4x corpus sweep on the mesh-ELL and
 #                     segments indexes (df_full_recomputes witness
 #                     asserted zero); writes BENCH_r09.json
+#   make bench-replay  r16 capture/replay bench: a zipfian closed loop
+#                     through a router with the durable request log
+#                     (capture) enabled, then the SAME traffic re-driven
+#                     open-loop at recorded offsets against a fresh
+#                     router — fidelity gated in-run (every captured
+#                     admitted request must replay admitted); writes
+#                     BENCH_r10.json
 
 #   make trace-demo   zero-to-aha for the tracing layer: spin a small
 #                     in-process cluster, kill a worker mid-request,
@@ -127,9 +145,9 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition chaos-autopilot chaos-router \
-        chaos-powerloss scrub \
+        chaos-powerloss chaos-upgrade scrub \
         faults bench bench-overload bench-routers bench-kernel \
-        probe-overlap \
+        bench-replay probe-overlap \
         graftcheck lockdep protocol-witness check trace-demo
 
 test:
@@ -152,7 +170,7 @@ lockdep:
 	  tests/test_admission.py tests/test_partition.py \
 	  tests/test_observability.py tests/test_autopilot.py \
 	  tests/test_router.py tests/test_storage.py \
-	  tests/test_commit_stats.py \
+	  tests/test_commit_stats.py tests/test_upgrade.py \
 	  tests/test_graftcheck.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
@@ -199,6 +217,9 @@ chaos-router:
 chaos-powerloss:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_storage.py $(PYTEST_FLAGS) -m slow
 
+chaos-upgrade:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_upgrade.py $(PYTEST_FLAGS) -m slow
+
 scrub:
 	python -m tfidf_tpu scrub
 
@@ -219,3 +240,6 @@ bench-routers:
 
 bench-kernel:
 	BENCH_OUT=BENCH_r09.json python bench.py --kernel
+
+bench-replay:
+	BENCH_OUT=BENCH_r10.json python bench.py --replay

@@ -24,7 +24,10 @@ def test_k8s_manifest_structure():
     assert kinds == ["Deployment", "Deployment",
                      "HorizontalPodAutoscaler",
                      "HorizontalPodAutoscaler",
-                     "Namespace", "Service", "Service", "Service",
+                     "Namespace",
+                     "PodDisruptionBudget", "PodDisruptionBudget",
+                     "PodDisruptionBudget",
+                     "Service", "Service", "Service",
                      "Service", "StatefulSet"]
     deployments = {d["metadata"]["name"]: d for d in docs
                    if d["kind"] == "Deployment"}
@@ -234,6 +237,54 @@ def test_k8s_router_tier():
         src = f.read()
     assert 'name="router_scatter"' in src
     assert '_queue_depth' in src
+
+
+def test_k8s_rolling_upgrade_budget():
+    """Zero-downtime fleet evolution (README "Versioning &
+    zero-downtime upgrades"): both Deployments roll one pod at a time
+    (maxUnavailable: 1 — the order chaos-upgrade rehearses) and every
+    tier carries a PodDisruptionBudget so voluntary drains obey the
+    same rule. The coordinator budget must preserve quorum (2 of 3);
+    the node budget must never leave fewer standing than the
+    replication factor the manifest itself configures."""
+    with open(os.path.join(ROOT, "deploy", "k8s.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    deployments = {d["metadata"]["name"]: d for d in docs
+                   if d["kind"] == "Deployment"}
+    for name, dep in deployments.items():
+        strat = dep["spec"]["strategy"]
+        assert strat["type"] == "RollingUpdate", name
+        assert strat["rollingUpdate"]["maxUnavailable"] == 1, name
+
+    pdbs = {d["metadata"]["name"]: d["spec"] for d in docs
+            if d["kind"] == "PodDisruptionBudget"}
+    assert set(pdbs) == {"tfidf-coordinator", "tfidf-node",
+                         "tfidf-router"}
+    # each budget selects its own tier's pods
+    for name, spec in pdbs.items():
+        assert spec["selector"]["matchLabels"] == {"app": name}, name
+
+    # coordinator: majority of the 3-member ensemble must stand
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+    assert pdbs["tfidf-coordinator"]["minAvailable"] >= (
+        sts["spec"]["replicas"] // 2 + 1)
+
+    # nodes: never fewer standing than the replication factor
+    node = deployments["tfidf-node"]
+    env = {e["name"]: e.get("value")
+           for e in node["spec"]["template"]["spec"]["containers"][0][
+               "env"]}
+    rf = int(env["TFIDF_REPLICATION_FACTOR"])
+    assert pdbs["tfidf-node"]["minAvailable"] >= rf
+    # and the budget is satisfiable: minAvailable < replicas, or no
+    # voluntary disruption is ever allowed and drains wedge forever
+    assert pdbs["tfidf-node"]["minAvailable"] < node["spec"]["replicas"]
+
+    # routers: the front door never drains empty
+    assert pdbs["tfidf-router"]["minAvailable"] >= 1
+    router = deployments["tfidf-router"]
+    assert pdbs["tfidf-router"]["minAvailable"] < router["spec"][
+        "replicas"]
 
 
 def test_dockerfile_structure():

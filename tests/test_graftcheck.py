@@ -226,15 +226,18 @@ def f():
 
     def test_detects_storage_seam_violations(self, tmp_path):
         """The storageseam pass: raw write-mode open / np.savez /
-        os.replace outside utils/storage.py are findings; read-mode
-        opens and the seam module itself are not."""
+        os.replace are findings EVERYWHERE — the seam module included
+        (its own primitives are explicit allowlist pins, not a silent
+        skip; the blanket skip used to hide any new durable-write
+        class that happened to live there) — while read-mode opens
+        are not."""
         from tools.graftcheck import storageseam
         tree = _mini_tree(tmp_path, {
             "utils/storage.py": '''
 import os
 
 def write_bytes(path, data):
-    with open(path, "wb") as f:   # the seam itself is exempt
+    with open(path, "wb") as f:   # flagged too: pinned, not skipped
         f.write(data)
 ''',
             "engine/rogue.py": '''
@@ -261,7 +264,11 @@ class Saver:
         assert "storageseam:raw-io:engine.rogue.Saver.save:replace" \
             in keys
         assert not any("Saver.load" in k for k in keys)
-        assert not any("utils.storage" in k for k in keys)
+        # the seam module is scanned like everything else now: its own
+        # write primitive surfaces as an explicit (allowlist-pinned)
+        # finding rather than vanishing behind a module-wide skip
+        assert "storageseam:raw-io:utils.storage.write_bytes:open:wb" \
+            in keys
 
     def test_storage_seam_clean_on_real_tree(self):
         """Every raw-IO site in the real tree is either migrated onto
@@ -874,6 +881,71 @@ class H(BaseHTTPRequestHandler):
                                                        str(tmp_path))}
         assert "protocol:status:fence-mismatch" in keys
 
+    def test_detects_version_surface_drift(self, tmp_path):
+        """The version pass (PR 16): an unversioned wire-table row, a
+        declared-version mismatch, a stale fingerprint pin, and a
+        proto-status disagreement between protover.py and
+        resilience.py are each findings; a consistent tree is clean.
+        Mini trees opt in by including cluster/protover.py."""
+        files = {
+            "cluster/protover.py":
+                "PROTO_VERSION = 2\nPROTO_STATUS = 426\n",
+            "cluster/resilience.py":
+                "_TRANSIENT_STATUSES = frozenset({503})\n"
+                "_FENCE_STATUS = 403\n_PROTO_STATUS = 426\n",
+            "cluster/h.py": '''
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def _send(self, code, body):
+        self.send_response(code)
+
+    def do_POST(self):
+        if self.path == "/worker/x":
+            self._send(200, b"ok")
+'''}
+        tree = _mini_tree(tmp_path, files)
+        fp = protocol.contract_fingerprint(tree)
+        # consistent README: version declared, row windowed, fp pinned
+        (tmp_path / "README.md").write_text(
+            "## Wire contract\n\n"
+            "| endpoint | methods | since | statuses |\n"
+            "|---|---|---|---|\n"
+            "| `/worker/x` | POST | 1– | 200 |\n\n"
+            "## Versioning\n\nCurrent wire version: **2**.\n"
+            f"Contract fingerprint: `{fp}`.\n")
+        assert not protocol.check_version_surface(tree, str(tmp_path))
+        # seed each violation in turn
+        (tmp_path / "README.md").write_text(
+            "## Wire contract\n\n"
+            "| endpoint | methods | since | statuses |\n"
+            "|---|---|---|---|\n"
+            "| `/worker/x` | POST | — | 200 |\n"
+            "| `/worker/y` | POST | 3– | 200 |\n\n"
+            "## Versioning\n\nCurrent wire version: **1**.\n"
+            "Contract fingerprint: `000000000000`.\n")
+        keys = {f.key
+                for f in protocol.check_version_surface(tree,
+                                                        str(tmp_path))}
+        assert "protocol:version:row-unversioned:/worker/x" in keys
+        assert "protocol:version:row-future:/worker/y" in keys
+        assert "protocol:version:declared-mismatch" in keys
+        assert "protocol:version:fingerprint-drift" in keys
+        # proto-status disagreement (the fence-mismatch analog)
+        files["cluster/resilience.py"] = (
+            "_TRANSIENT_STATUSES = frozenset({503})\n"
+            "_FENCE_STATUS = 403\n_PROTO_STATUS = 410\n")
+        tree2 = _mini_tree(tmp_path, files)
+        keys2 = {f.key
+                 for f in protocol.check_version_surface(tree2,
+                                                         str(tmp_path))}
+        assert "protocol:version:proto-status-mismatch" in keys2
+        # trees without protover.py (all pre-PR-16 fixtures) are exempt
+        del files["cluster/protover.py"]
+        (tmp_path / gc_core.PACKAGE / "cluster" / "protover.py").unlink()
+        tree3 = _mini_tree(tmp_path, files)
+        assert not protocol.check_version_surface(tree3, str(tmp_path))
+
     def test_detects_raw_transport_bypass(self, tmp_path):
         """A raw transport outside the nemesis+trace seams is the
         'same shared seams' invariant breaking."""
@@ -948,8 +1020,8 @@ class TestProtocolRealTree:
 
     def test_status_contract_pinned(self, tree):
         c = protocol.build_contract(REPO_ROOT, tree)
-        assert c.statuses == {200, 400, 403, 404, 409, 415, 421, 429,
-                              500, 503, 504, 507}
+        assert c.statuses == {200, 400, 403, 404, 409, 415, 421, 426,
+                              429, 500, 503, 504, 507}
 
     def test_protocol_clean_on_real_tree(self, tree):
         allow = load_allowlist()
@@ -1022,7 +1094,8 @@ class TestProtocolWitnessSeeded:
         the run never exercised fails the witness."""
         w = ProtocolWitness(contract=wire_contract)
         w.observe("front", "POST", "/leader/start", 200,
-                  ["X-Trace-Id", "X-Route-Generation", "X-Route-Epoch"])
+                  ["X-Trace-Id", "X-Route-Generation", "X-Route-Epoch",
+                   "X-Proto-Version"])
         w.check(require_exercised={"/leader/start"})
         with pytest.raises(AssertionError, match="never exercised"):
             w.check(require_exercised={"/leader/start",

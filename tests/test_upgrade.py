@@ -1,0 +1,697 @@
+"""Zero-downtime fleet evolution: wire-protocol versioning, the
+version-skew nemesis, traffic capture/replay, and rolling-upgrade
+chaos.
+
+Tier-1 pins (fast):
+
+- ``cluster/protover.py`` pure semantics: header parsing (absent /
+  malformed -> implicit version 1), the compat window (floor only, no
+  ceiling), the outbound stamp.
+- The version gate at the handler seam: in-window and future versions
+  accepted, below-floor answered with the DISTINCT status 426 +
+  ``X-Proto-Rejected: 1`` + a structured body naming both sides'
+  versions; ops endpoints ungated; unknown request headers pass
+  through (forward compatibility).
+- Classification: a proto rejection is never retryable and never a
+  worker fault, so rolling-upgrade skew cannot trip breakers.
+- The skew nemesis: per-link header masking at the transport seams,
+  end-to-end into a raised-floor node.
+- Capture/replay: CRC-framed request-log roundtrip, torn-tail
+  truncation, entry bound, the admitted-only tap at the front door,
+  and replay determinism — the same captured log drives two fresh
+  clusters to identical admitted counts and identical results.
+- ``cli status``: the per-member proto-version table and the
+  mixed-version flag.
+
+Slow (``make chaos-upgrade``): a rolling restart workers -> router ->
+leader under live zipfian read load and a write stream, with the
+version-skew nemesis, a partition, and a storage fault riding along —
+asserting zero acked-write loss, a bounded shed fraction, exact oracle
+parity after every step, and that the skew window tripped proto
+rejections but never a breaker.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tfidf_tpu.cluster.coordination import CoordinationCore
+from tfidf_tpu.cluster.nemesis import NemesisNet, global_nemesis
+from tfidf_tpu.cluster.node import http_post
+from tfidf_tpu.cluster.protover import (IMPLICIT_VERSION, PROTO_HEADER,
+                                        PROTO_REJECTED_HEADER, PROTO_STATUS,
+                                        PROTO_VERSION, in_window,
+                                        parse_version, proto_headers)
+from tfidf_tpu.cluster.resilience import (RpcStatusError, is_proto_rejection,
+                                          is_retryable, is_worker_fault)
+from tfidf_tpu.utils.metrics import global_metrics
+from tfidf_tpu.utils.storage import RequestLog, global_storage
+
+from tests.test_cluster import wait_until
+from tests.test_partition import (DOCS, QUERIES, _CFG, _node, _oracle,
+                                  _parity, _search, _stop_all, _upload_docs)
+from tests.test_router import _mk_router
+
+
+@pytest.fixture(autouse=True)
+def _heal_all():
+    """Every test leaves the process-global nemeses healed."""
+    yield
+    global_nemesis.heal()
+    global_storage.heal()
+
+
+@pytest.fixture
+def core():
+    c = CoordinationCore(session_timeout_s=0.5)
+    yield c
+    c.close()
+
+
+def _raw(url, data=None, headers=None, timeout=10.0):
+    """A request OUTSIDE the stamping seams: exactly the wire an
+    old (pre-versioning) binary puts on the network."""
+    req = urllib.request.Request(url, data=data, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _pair(core, tmp_path, base=0, **leader_kw):
+    """The smallest cluster that serves uploads: a leader plus one
+    registered worker. Returns [leader, worker]."""
+    leader = _node(core, tmp_path, base, **leader_kw)
+    worker = _node(core, tmp_path, base + 1)
+    wait_until(lambda: len(
+        leader.registry.get_all_service_addresses()) == 1)
+    return [leader, worker]
+
+
+# ---------------------------------------------------------------------------
+# protover pure semantics
+# ---------------------------------------------------------------------------
+
+class TestProtoverPure:
+    def test_parse_version_absent_is_implicit(self):
+        assert parse_version(None) == IMPLICIT_VERSION
+
+    def test_parse_version_values(self):
+        assert parse_version("2") == 2
+        assert parse_version(" 3 ") == 3
+        assert parse_version(str(PROTO_VERSION)) == PROTO_VERSION
+
+    def test_parse_version_malformed_is_implicit(self):
+        # garbage never escalates to a rejection the sender cannot
+        # act on — malformed headers are the pre-versioning wire
+        for bad in ("", "banana", "0", "-4", "2.5"):
+            assert parse_version(bad) == IMPLICIT_VERSION, bad
+
+    def test_window_floor_only(self):
+        assert in_window(1, 1)
+        assert in_window(PROTO_VERSION, 1)
+        assert not in_window(1, PROTO_VERSION)
+        # deliberately no ceiling: a newer peer is always accepted
+        assert in_window(99, PROTO_VERSION)
+
+    def test_outbound_stamp(self):
+        assert proto_headers() == {PROTO_HEADER: str(PROTO_VERSION)}
+
+
+# ---------------------------------------------------------------------------
+# the version gate at the handler seam
+# ---------------------------------------------------------------------------
+
+class TestVersionGate:
+    def test_replies_stamped_and_health_carries_version(self, core,
+                                                        tmp_path):
+        nd = _node(core, tmp_path, 0)
+        try:
+            st, hdrs, body = _raw(nd.url + "/api/health")
+            assert st == 200
+            assert hdrs.get(PROTO_HEADER) == str(PROTO_VERSION)
+            h = json.loads(body)
+            assert h["proto_version"] == PROTO_VERSION
+            assert "role" in h
+        finally:
+            nd.stop()
+
+    def test_below_floor_rejected_distinctly(self, core, tmp_path):
+        nd = _node(core, tmp_path, 0, proto_min_compat=PROTO_VERSION)
+        try:
+            before = global_metrics.get("proto_rejections")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                # no X-Proto-Version header: implicit version 1, which
+                # is below this node's floor
+                _raw(nd.url + "/leader/start",
+                     data=json.dumps({"query": "x"}).encode(),
+                     headers={"Content-Type": "application/json"})
+            e = ei.value
+            assert e.code == PROTO_STATUS
+            assert e.headers.get(PROTO_REJECTED_HEADER) == "1"
+            detail = json.loads(e.read())
+            assert detail["declared"] == IMPLICIT_VERSION
+            assert detail["min_compat"] == PROTO_VERSION
+            assert detail["server_version"] == PROTO_VERSION
+            assert global_metrics.get("proto_rejections") > before
+        finally:
+            nd.stop()
+
+    def test_in_window_and_future_accepted(self, core, tmp_path):
+        nodes = _pair(core, tmp_path,
+                      proto_min_compat=PROTO_VERSION)
+        try:
+            _upload_docs(nodes[0].url, {"a.txt": "alpha beta"})
+            for declared in (str(PROTO_VERSION), "99"):
+                st, hdrs, body = _raw(
+                    nodes[0].url + "/leader/start",
+                    data=json.dumps({"query": "alpha"}).encode(),
+                    headers={"Content-Type": "application/json",
+                             PROTO_HEADER: declared})
+                assert st == 200, declared
+                assert hdrs.get(PROTO_HEADER) == str(PROTO_VERSION)
+                assert "a.txt" in json.loads(body)
+        finally:
+            _stop_all(nodes)
+
+    def test_ops_endpoints_ungated(self, core, tmp_path):
+        # an operator must be able to inspect a node whatever binary
+        # they run — /api/* never version-rejects
+        nd = _node(core, tmp_path, 0, proto_min_compat=PROTO_VERSION)
+        try:
+            for path in ("/api/health", "/api/status", "/api/metrics"):
+                st, _, _ = _raw(nd.url + path)
+                assert st == 200, path
+        finally:
+            nd.stop()
+
+    def test_unknown_request_headers_pass_through(self, core, tmp_path):
+        # forward compatibility: a newer peer only ever ADDS surface;
+        # headers this binary has never heard of are ignored, not
+        # rejected
+        nodes = _pair(core, tmp_path,
+                      proto_min_compat=PROTO_VERSION)
+        try:
+            _upload_docs(nodes[0].url, {"a.txt": "alpha beta"})
+            st, _, body = _raw(
+                nodes[0].url + "/leader/start",
+                data=json.dumps({"query": "alpha"}).encode(),
+                headers={"Content-Type": "application/json",
+                         PROTO_HEADER: str(PROTO_VERSION),
+                         "X-Future-Capability": "1",
+                         "X-Another-Unknown": "yes"})
+            assert st == 200
+            assert "a.txt" in json.loads(body)
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# classification: proto rejections never retry, never trip breakers
+# ---------------------------------------------------------------------------
+
+class TestProtoClassification:
+    def test_rpc_status_error_flag(self):
+        e = RpcStatusError("http://w:1", PROTO_STATUS, proto=True)
+        assert is_proto_rejection(e)
+        assert not is_retryable(e)
+        assert not is_worker_fault(e)
+
+    def test_real_wire_rejection_classified(self, core, tmp_path):
+        nd = _node(core, tmp_path, 0, proto_min_compat=PROTO_VERSION)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _raw(nd.url + "/worker/names")
+            e = ei.value
+            assert is_proto_rejection(e)
+            assert not is_retryable(e)
+            assert not is_worker_fault(e)
+        finally:
+            nd.stop()
+
+    def test_other_statuses_not_proto(self):
+        assert not is_proto_rejection(RpcStatusError("http://w:1", 500))
+        assert not is_proto_rejection(RpcStatusError("http://w:1", 429))
+
+
+# ---------------------------------------------------------------------------
+# the version-skew nemesis
+# ---------------------------------------------------------------------------
+
+class TestSkewNemesis:
+    def test_filter_headers_masks_per_link(self):
+        net = NemesisNet()
+        h = {PROTO_HEADER: "2", "X-Other": "kept"}
+        # inactive: passthrough
+        assert net.filter_headers("http://a:1", "http://b:2", h) == h
+        net.skew(src="http://a:1", dst="http://b:2")
+        masked = net.filter_headers("http://a:1", "http://b:2", dict(h))
+        assert PROTO_HEADER not in masked
+        assert masked["X-Other"] == "kept"
+        # a different link is untouched
+        assert net.filter_headers("http://c:3", "http://b:2", dict(h)) == h
+        net.heal()
+
+    def test_filter_headers_case_insensitive(self):
+        net = NemesisNet()
+        net.skew(dst="http://b:2")
+        before = global_metrics.get("nemesis_header_masks")
+        masked = net.filter_headers(None, "http://b:2",
+                                    {"x-proto-version": "2"})
+        assert masked == {}
+        assert global_metrics.get("nemesis_header_masks") > before
+        net.heal()
+
+    def test_skew_end_to_end(self, core, tmp_path):
+        # strip the stamp on every link into a raised-floor node: the
+        # node sees an old-binary peer and answers with the distinct
+        # rejection, which the classifier refuses to blame on the
+        # worker — then heal, and the same call succeeds
+        nodes = _pair(core, tmp_path,
+                      proto_min_compat=PROTO_VERSION)
+        lead = nodes[0]
+        try:
+            _upload_docs(lead.url, {"a.txt": "alpha beta"})
+            global_nemesis.skew(dst=lead.url)
+            masks0 = global_metrics.get("nemesis_header_masks")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_post(lead.url + "/leader/start",
+                          json.dumps({"query": "alpha"}).encode(),
+                          origin="http://client:0")
+            assert ei.value.code == PROTO_STATUS
+            assert is_proto_rejection(ei.value)
+            assert not is_worker_fault(ei.value)
+            assert global_metrics.get("nemesis_header_masks") > masks0
+            global_nemesis.heal()
+            got = json.loads(http_post(
+                lead.url + "/leader/start",
+                json.dumps({"query": "alpha"}).encode(),
+                origin="http://client:0"))
+            assert "a.txt" in got
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# traffic capture / replay
+# ---------------------------------------------------------------------------
+
+class TestCaptureReplay:
+    def test_requestlog_roundtrip(self, tmp_path):
+        p = str(tmp_path / "cap" / "requests.log")
+        rlog = RequestLog(p)
+        assert rlog.record("alpha", "interactive", "c1")
+        assert rlog.record("beta gamma", "bulk", "c2")
+        assert rlog.record("delta", "interactive")
+        rlog.close()
+        entries = RequestLog.read(p)
+        assert [e["query"] for e in entries] == ["alpha", "beta gamma",
+                                                 "delta"]
+        assert [e["lane"] for e in entries] == ["interactive", "bulk",
+                                                "interactive"]
+        assert entries[0]["client"] == "c1"
+        ts = [e["t"] for e in entries]
+        assert ts == sorted(ts) and ts[0] >= 0.0
+
+    def test_requestlog_torn_tail_truncates_cleanly(self, tmp_path):
+        p = str(tmp_path / "requests.log")
+        rlog = RequestLog(p)
+        rlog.record("alpha", "interactive")
+        rlog.record("beta", "interactive")
+        rlog.close()
+        with open(p, "ab") as f:
+            # a torn frame: valid-looking CRC prefix, truncated body
+            f.write(b'00000000 {"t":1.0,"query":"tor')
+        entries = RequestLog.read(p)
+        assert [e["query"] for e in entries] == ["alpha", "beta"]
+
+    def test_requestlog_entry_bound(self, tmp_path):
+        p = str(tmp_path / "requests.log")
+        rlog = RequestLog(p, max_entries=2)
+        assert rlog.record("a", "interactive")
+        assert rlog.record("b", "interactive")
+        assert not rlog.record("c", "interactive")
+        rlog.close()
+        assert not rlog.record("d", "interactive")
+        assert len(RequestLog.read(p)) == 2
+
+    def test_front_door_tap_captures_admitted_only_fields(self, core,
+                                                          tmp_path):
+        cap = str(tmp_path / "cap" / "requests.log")
+        nodes = _pair(core, tmp_path, replay_capture_path=cap)
+        lead = nodes[0]
+        try:
+            _upload_docs(lead.url, {"a.txt": "alpha beta"})
+            http_post(lead.url + "/leader/start",
+                      json.dumps({"query": "alpha"}).encode())
+            http_post(lead.url + "/leader/start",
+                      json.dumps({"query": "beta"}).encode(),
+                      headers={"X-Priority": "bulk", "X-Client-Id": "c9"})
+        finally:
+            _stop_all(nodes)
+        entries = RequestLog.read(cap)
+        assert [e["query"] for e in entries] == ["alpha", "beta"]
+        assert entries[0]["lane"] == "interactive"
+        assert entries[1]["lane"] == "bulk"
+        assert entries[1]["client"] == "c9"
+
+    @staticmethod
+    def _replay(url, entries):
+        """Re-drive a captured log through a front door: admitted
+        count + per-request results (name -> rounded score)."""
+        admitted, results = 0, []
+        for e in entries:
+            headers = {}
+            if e.get("lane") == "bulk":
+                headers["X-Priority"] = "bulk"
+            if e.get("client"):
+                headers["X-Client-Id"] = e["client"]
+            try:
+                body = http_post(url + "/leader/start",
+                                 json.dumps({"query": e["query"]}).encode(),
+                                 headers=headers)
+                admitted += 1
+                results.append({k: round(v, 4)
+                                for k, v in json.loads(body).items()})
+            except urllib.error.HTTPError:
+                results.append(None)
+        return admitted, results
+
+    def test_replay_determinism_identical_admitted_counts(self, tmp_path):
+        # capture a fixed workload on one cluster, then replay the log
+        # into two FRESH clusters over the same corpus: both must admit
+        # the same count and return the same results
+        queries = QUERIES * 3
+        cap = str(tmp_path / "cap" / "requests.log")
+        core_a = CoordinationCore(session_timeout_s=0.5)
+        cluster_a = _pair(core_a, tmp_path, replay_capture_path=cap)
+        try:
+            _upload_docs(cluster_a[0].url, DOCS)
+            for q in queries:
+                http_post(cluster_a[0].url + "/leader/start",
+                          json.dumps({"query": q}).encode())
+        finally:
+            _stop_all(cluster_a)
+            core_a.close()
+        entries = RequestLog.read(cap)
+        assert [e["query"] for e in entries] == queries
+
+        replays = []
+        for base in (5, 7):
+            c = CoordinationCore(session_timeout_s=0.5)
+            fresh = _pair(c, tmp_path, base=base)
+            try:
+                _upload_docs(fresh[0].url, DOCS)
+                replays.append(self._replay(fresh[0].url, entries))
+            finally:
+                _stop_all(fresh)
+                c.close()
+        (adm_b, res_b), (adm_c, res_c) = replays
+        assert adm_b == adm_c == len(entries)
+        assert res_b == res_c
+
+
+# ---------------------------------------------------------------------------
+# cli status: the fleet's version table
+# ---------------------------------------------------------------------------
+
+class TestStatusVersions:
+    def test_status_reports_proto_versions(self, core, tmp_path, capsys):
+        from tests.test_cli import run_cli
+        nodes = [_node(core, tmp_path, i) for i in range(2)]
+        try:
+            wait_until(lambda: len(
+                nodes[0].registry.get_all_service_addresses()) == 1)
+            rc, out = run_cli(capsys, "status", "--leader", nodes[0].url)
+            assert rc == 0
+            st = json.loads(out)
+            v = st["versions"]
+            assert v["proto_versions_seen"] == [PROTO_VERSION]
+            assert v["mixed_versions"] is False
+            assert len(v["members"]) >= 2
+            assert all(m["proto_version"] == PROTO_VERSION
+                       for m in v["members"] if m["reachable"])
+        finally:
+            _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# rolling-upgrade chaos (make chaos-upgrade)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestChaosUpgrade:
+    @pytest.mark.timeout(420)
+    def test_rolling_upgrade_zero_loss_exact_parity(self, tmp_path):
+        """Workers -> router -> leader restart one at a time under live
+        zipfian read load and a write stream, with a version-skew
+        window, a partition, and a storage fault riding along. The
+        fleet must stay exact the whole way: zero acked-write loss,
+        bounded shed, oracle parity after every step, and the skew
+        window must surface as proto rejections — never as breaker
+        trips."""
+        core = CoordinationCore(session_timeout_s=1.0)
+        kw = dict(replication_factor=3, rpc_max_attempts=2,
+                  breaker_failure_threshold=3, breaker_reset_s=0.5)
+        # a mixed fleet from the start: node 2 is the "new binary"
+        # whose floor already requires the versioned wire
+        nodes = [_node(core, tmp_path, i,
+                       proto_min_compat=(PROTO_VERSION if i == 2 else 1),
+                       **kw)
+                 for i in range(3)]
+        router = _mk_router(core, **kw)
+        front = {"url": router.url}
+        stop_evt = threading.Event()
+        lock = threading.Lock()
+        acked = {}                       # name -> text, confirmed 200
+        attempted = {}                   # name -> text, sent at all
+        counts = {"ok": 0, "shed": 0, "proto": 0, "err": 0}
+        threads = []
+        try:
+            wait_until(lambda: len(
+                nodes[0].registry.get_all_service_addresses()) == 2,
+                timeout=20)
+            assert wait_until(lambda: any(nd.is_leader() for nd in nodes),
+                              timeout=20)
+            leader = next(nd for nd in nodes if nd.is_leader())
+            # the oracle is over the STATIC corpus only — the write
+            # stream uses disjoint tokens, so parity probes are
+            # independent of writer progress
+            r = _upload_docs(front["url"], DOCS)
+            assert r
+            # mid-run probes check exact result MEMBERSHIP: the write
+            # stream's disjoint tokens never appear in these results,
+            # but growing the corpus shifts IDF, so score-exact parity
+            # is only well-defined once writes quiesce (checked at the
+            # end against an oracle over the resolved corpus)
+            want_names = {q: set(o)
+                          for q, o in _oracle(tmp_path, DOCS,
+                                              QUERIES).items()}
+
+            def settled(q):
+                try:
+                    return set(_search(front["url"], q)) == want_names[q]
+                except Exception:
+                    return False
+
+            def assert_parity(step):
+                for q in QUERIES:
+                    assert wait_until(lambda: settled(q), timeout=30), \
+                        f"exact results lost after {step}: {q!r}"
+
+            tokens = ["common", "token1", "token3 word0", "word1",
+                      "extra2", "common token7", "word2", "token5"]
+            zipf_w = [1.0 / (i + 1) for i in range(len(tokens))]
+
+            def reader(seed):
+                rng = random.Random(seed)
+                while not stop_evt.is_set():
+                    q = rng.choices(tokens, weights=zipf_w)[0]
+                    try:
+                        http_post(front["url"] + "/leader/start",
+                                  json.dumps({"query": q}).encode(),
+                                  timeout=5.0)
+                        k = "ok"
+                    except urllib.error.HTTPError as e:
+                        k = ("shed" if e.code == 429 else
+                             "proto" if e.code == PROTO_STATUS else "err")
+                    except Exception:
+                        k = "err"
+                    with lock:
+                        counts[k] += 1
+                    time.sleep(0.01)
+
+            def writer():
+                k = 0
+                while not stop_evt.is_set() and k < 400:
+                    name, text = f"up{k}.txt", f"shared uq{k}tok"
+                    k += 1
+                    with lock:
+                        attempted[name] = text
+                    try:
+                        http_post(
+                            front["url"] + "/leader/upload-batch",
+                            json.dumps([{"name": name,
+                                         "text": text}]).encode(),
+                            timeout=8.0)
+                        with lock:
+                            acked[name] = text
+                    except Exception:
+                        pass    # ambiguous: never counted as acked
+                    time.sleep(0.05)
+
+            threads = [threading.Thread(target=reader, args=(s,),
+                                        daemon=True) for s in (1, 2)]
+            threads.append(threading.Thread(target=writer, daemon=True))
+            for t in threads:
+                t.start()
+            time.sleep(2.0)
+            assert_parity("warmup")
+
+            # ---- mixed-version window: strip the stamp on every link
+            # into the raised-floor node. Its 426s must never look
+            # like worker faults, so no breaker may open.
+            rej0 = global_metrics.get("proto_rejections")
+            masks0 = global_metrics.get("nemesis_header_masks")
+            opened0 = global_metrics.get("breaker_opened")
+            global_nemesis.skew(dst=nodes[2].url)
+            time.sleep(3.0)
+            assert_parity("version-skew window")
+            global_nemesis.heal()
+            assert global_metrics.get("nemesis_header_masks") > masks0
+            assert global_metrics.get("proto_rejections") > rej0
+            assert global_metrics.get("breaker_opened") == opened0, \
+                "a proto rejection tripped a breaker"
+
+            # ---- the rest of the chaos rides along: a brief
+            # partition around one replica plus a bounded storage
+            # fault under it
+            global_storage.arm("fsync_eio",
+                               str(tmp_path / "pt1") + "/*", times=2)
+            global_nemesis.partition(
+                [nodes[1].url],
+                [nodes[0].url, nodes[2].url, router.url])
+            time.sleep(2.0)
+            global_nemesis.heal()
+            global_storage.heal()
+            assert_parity("partition + storage fault")
+
+            # ---- rolling restart, workers first. Each replacement is
+            # the upgraded binary: floor raised to the current wire.
+            for i, nd in enumerate(list(nodes)):
+                if nd.is_leader():
+                    continue
+                nd.stop()
+                assert_parity(f"worker {i} down")
+                nodes[i] = _node(core, tmp_path, i,
+                                 proto_min_compat=PROTO_VERSION, **kw)
+                assert wait_until(lambda: len(
+                    leader.registry.get_all_service_addresses()) == 2,
+                    timeout=30)
+                assert_parity(f"worker {i} upgraded")
+
+            # ---- router next, surge style (start the upgraded one,
+            # move traffic, retire the old) — the front door never
+            # goes dark
+            new_router = _mk_router(core, proto_min_compat=PROTO_VERSION,
+                                    **kw)
+            old_router, front["url"] = router, new_router.url
+            router = new_router
+            old_router.stop()
+            assert_parity("router upgraded")
+
+            # ---- leader last: stop it, let the survivors elect, then
+            # bring back the upgraded binary
+            li = nodes.index(leader)
+            leader.stop()
+            assert wait_until(
+                lambda: any(nd.is_leader()
+                            for j, nd in enumerate(nodes) if j != li),
+                timeout=30)
+            nodes[li] = _node(core, tmp_path, li,
+                              proto_min_compat=PROTO_VERSION, **kw)
+            leader = next(nd for nd in nodes if nd.is_leader())
+            assert wait_until(lambda: len(
+                leader.registry.get_all_service_addresses()) == 2,
+                timeout=30)
+            assert_parity("leader upgraded")
+
+            # ---- quiesce the load and verify the end state
+            stop_evt.set()
+            for t in threads:
+                t.join(timeout=15)
+
+            assert_parity("final")
+            # zero acked-write loss: every confirmed write answers by
+            # its unique token through the upgraded front door. An
+            # AMBIGUOUS write (no ack came back) is resolved by the
+            # same probe — present or absent, either is legal, but the
+            # oracle corpus must match whichever happened.
+            resolved = dict(DOCS)
+            missing = []
+            for name, text in sorted(attempted.items()):
+                tok = text.split()[1]
+
+                def present():
+                    try:
+                        return name in _search(front["url"], tok)
+                    except Exception:
+                        return False
+                if name in acked:
+                    if not wait_until(present, timeout=15):
+                        missing.append((name, tok))
+                    else:
+                        resolved[name] = text
+                elif present():
+                    resolved[name] = text
+            assert not missing, \
+                f"acked writes lost across the upgrade: {missing[:5]}"
+
+            # with writes quiesced and the corpus resolved, parity is
+            # score-EXACT against a fresh single-node oracle
+            final_want = _oracle(tmp_path / "final", resolved, QUERIES)
+
+            def exact(q):
+                try:
+                    return _parity(_search(front["url"], q),
+                                   final_want[q])
+                except Exception:
+                    return False
+            for q in QUERIES:
+                assert wait_until(lambda: exact(q), timeout=60), \
+                    f"exact score parity lost at the end: {q!r}"
+
+            total = sum(counts.values())
+            assert counts["ok"] >= 100, counts
+            # bounded shed spike: the rolling restart may shed, but
+            # the front door must keep serving
+            assert counts["shed"] / max(1, total) <= 0.5, counts
+            # readers stamp the current version — the fleet's raised
+            # floors never reject them
+            assert counts["proto"] == 0, counts
+
+            # the upgrade is complete: the whole fleet (router
+            # included) now refuses the pre-versioning wire ...
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _raw(front["url"] + "/leader/start",
+                     data=json.dumps({"query": "common"}).encode(),
+                     headers={"Content-Type": "application/json"})
+            assert ei.value.code == PROTO_STATUS
+            assert ei.value.headers.get(PROTO_REJECTED_HEADER) == "1"
+            # ... while stamped traffic flows
+            assert _parity(_search(front["url"], "common"),
+                           final_want["common"])
+        finally:
+            stop_evt.set()
+            global_nemesis.heal()
+            global_storage.heal()
+            _stop_all(nodes)
+            for rt in {router}:
+                try:
+                    rt.stop()
+                except Exception:
+                    pass
+            core.close()

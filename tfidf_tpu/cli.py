@@ -583,6 +583,36 @@ def cmd_status(args) -> int:
             entries.append(entry)
         out["routers"] = {"count": len(router_urls),
                           "routers": entries}
+    # fleet wire-version summary (README "Versioning & upgrades"):
+    # each member's declared proto version from /api/health. A member
+    # whose health reply predates versioning speaks the implicit
+    # version 1. A mixed-version fleet is normal MID-upgrade and a
+    # finding at any other time — `status` flags it instead of hiding
+    # it behind per-node queries.
+    members = [("node", url)] + [("node", str(s))
+                                 for s in out["services"]] \
+        + [("router", str(r)) for r in router_urls]
+    versions = []
+    for role, member in members:
+        try:
+            h = json.loads(http_get(
+                member.rstrip("/") + "/api/health", timeout=3.0))
+            versions.append({"url": member,
+                             "role": h.get("role", role),
+                             "proto_version":
+                                 int(h.get("proto_version", 1)),
+                             "reachable": True})
+        except Exception:
+            versions.append({"url": member, "role": role,
+                             "proto_version": None,
+                             "reachable": False})
+    seen = sorted({v["proto_version"] for v in versions
+                   if v["proto_version"] is not None})
+    out["versions"] = {
+        "members": versions,
+        "proto_versions_seen": seen,
+        "mixed_versions": len(seen) > 1,
+    }
     out["admission"] = {
         "admitted_total": int(metrics.get("admission_admitted", 0)),
         "shed_total": int(metrics.get("admission_shed_total", 0)),

@@ -40,6 +40,8 @@ from typing import Callable, NamedTuple
 from urllib.parse import parse_qs, urlparse
 
 from tfidf_tpu.cluster.nemesis import global_nemesis
+from tfidf_tpu.cluster.protover import (PROTO_HEADER, PROTO_VERSION,
+                                        proto_headers)
 from tfidf_tpu.cluster.resilience import RetryPolicy
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
@@ -624,6 +626,11 @@ class _CoordHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # coordination plane speaks the same versioned wire as the data
+        # plane so the protocol witness can assert the stamp on every
+        # exchange (cluster/protover.py; the plane itself negotiates
+        # nothing — /rpc is an internal seam with one client, this repo)
+        self.send_header(PROTO_HEADER, str(PROTO_VERSION))
         self.end_headers()
         self.wfile.write(body)
 
@@ -949,9 +956,11 @@ class CoordinationClient(_BaseCoordination):
         while tries == 0 or time.monotonic() < deadline:
             tries += 1
             base = self._current()
+            h = {"Content-Type": "application/json"}
+            h.update(proto_headers())
+            h = global_nemesis.filter_headers(self.origin, base, h)
             r = urllib.request.Request(f"http://{base}/rpc", data=body,
-                                       headers={"Content-Type":
-                                                "application/json"})
+                                       headers=h)
             try:
                 global_nemesis.check_send(self.origin, base)
                 with urllib.request.urlopen(
@@ -1087,10 +1096,13 @@ class CoordinationClient(_BaseCoordination):
             base = self._current()
             url = (f"http://{base}/events?session={self.sid}"
                    f"&timeout={timeout_s}")
+            poll_req = urllib.request.Request(
+                url, headers=global_nemesis.filter_headers(
+                    self.origin, base, proto_headers()))
             try:
                 global_nemesis.check_send(self.origin, base)
                 with urllib.request.urlopen(
-                        url, timeout=timeout_s + 5) as resp:
+                        poll_req, timeout=timeout_s + 5) as resp:
                     payload = json.loads(global_nemesis.filter_reply(
                         self.origin, base, resp.read()))
                 self._note_success(base)

@@ -51,6 +51,7 @@ from tfidf_tpu.cluster.coordination import (CoordinationCore,
                                             CoordinationUnavailable,
                                             NotLeaderError)
 from tfidf_tpu.cluster.nemesis import global_nemesis
+from tfidf_tpu.cluster.protover import proto_headers
 from tfidf_tpu.cluster.wal import DurableStore
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
@@ -69,9 +70,11 @@ def _post_json(address: str, path: str, obj: dict,
     # ensemble splits are scripted per (member, member) link
     global_nemesis.check_send(origin, address)
     body = json.dumps(obj).encode()
+    h = {"Content-Type": "application/json"}
+    h.update(proto_headers())
+    h = global_nemesis.filter_headers(origin, address, h)
     req = urllib.request.Request(
-        f"http://{address}{path}", data=body,
-        headers={"Content-Type": "application/json"})
+        f"http://{address}{path}", data=body, headers=h)
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         return json.loads(global_nemesis.filter_reply(
             origin, address, resp.read()))

@@ -22,7 +22,12 @@ single call site:
 - **delay** — injected latency (+ optional jitter) before the request
   goes out: the gray-failure generator for the latency-EWMA breaker;
 - **truncate** / **corrupt** — the reply arrives damaged, exercising
-  the wire layer's ValueError contract and the scatter failure paths.
+  the wire layer's ValueError contract and the scatter failure paths;
+- **skew** — outbound request headers are masked per link
+  (:meth:`NemesisNet.filter_headers`, consulted by the same client
+  seams), simulating an old-binary peer that never learned them: the
+  version-skew generator for the rolling-upgrade chaos schedule
+  (``make chaos-upgrade``; see cluster/protover.py).
 
 Links are identified by ``(source endpoint, destination endpoint)``
 where an endpoint is ``host:port``. Sources are stamped on the client
@@ -57,6 +62,7 @@ DROP_REPLY = "drop_reply"
 DELAY = "delay"
 TRUNCATE = "truncate"
 CORRUPT = "corrupt"
+SKEW = "skew"
 
 
 class NemesisFault(ConnectionError):
@@ -108,6 +114,9 @@ class _Rule:
     delay_s: float = 0.0
     jitter_s: float = 0.0
     keep_bytes: int = 0         # truncate: reply bytes kept
+    # skew: lowercased header names masked off src→dst requests (an
+    # old-binary peer that never sends them)
+    strip: frozenset | None = None
     # both endpoints inside this set -> the rule does not apply (an
     # isolated MINORITY keeps its internal links; see isolate())
     exempt: frozenset | None = None
@@ -182,6 +191,17 @@ class NemesisNet:
         """Flip bytes in src→dst replies (wire-validation exercise)."""
         return self._add(CORRUPT, src, dst, probability=probability)
 
+    def skew(self, src=None, dst=None,
+             strip=("X-Proto-Version",), probability: float = 1.0) -> int:
+        """Version-skew: mask ``strip`` headers off src→dst requests so
+        the destination sees an old-binary peer (a request with no
+        ``X-Proto-Version`` is implicitly wire version 1 — see
+        cluster/protover.py). The rolling-upgrade chaos schedule arms
+        this per link to hold mixed-version traffic on the cluster
+        while processes restart one at a time."""
+        return self._add(SKEW, src, dst, probability=probability,
+                         strip=frozenset(h.lower() for h in strip))
+
     def one_way(self, a, b) -> int:
         """Asymmetric partition: a→b requests drop; b→a flows."""
         return self.drop(src=a, dst=b)
@@ -247,6 +267,31 @@ class NemesisNet:
         if delay > 0:
             global_metrics.inc("nemesis_delays")
             self._sleep(delay)
+
+    def filter_headers(self, src, dst, headers: dict) -> dict:
+        """Called by a transport seam with the outbound request headers
+        BEFORE they go out; returns the (possibly masked) headers the
+        destination will actually see. Only skew rules apply — with
+        none armed this returns ``headers`` untouched (same emptiness
+        fast path as the other seams)."""
+        rules = self._rules
+        if not rules:
+            return headers
+        s, d = endpoint_of(src), endpoint_of(dst)
+        strip: set[str] = set()
+        for r in rules:
+            if r.kind != SKEW or not r.matches(s, d):
+                continue
+            if r.probability < 1.0 and self._rng.random() > r.probability:
+                continue
+            strip |= r.strip or frozenset()
+        if not strip:
+            return headers
+        masked = {k: v for k, v in headers.items()
+                  if k.lower() not in strip}
+        if len(masked) != len(headers):
+            global_metrics.inc("nemesis_header_masks")
+        return masked
 
     def filter_reply(self, src, dst, body: bytes) -> bytes:
         """Called by a transport seam AFTER the reply bytes arrived.

@@ -55,6 +55,8 @@ from tfidf_tpu.cluster.fencing import (FENCE_EPOCH_HEADER, FENCE_HEADER,
                                        FENCE_REJECTED_HEADER,
                                        FENCE_STATUS, FenceGuard)
 from tfidf_tpu.cluster.nemesis import global_nemesis
+from tfidf_tpu.cluster.protover import (PROTO_REJECTED_HEADER,
+                                        PROTO_VERSION, proto_headers)
 from tfidf_tpu.cluster.placement import PlacementFollower, PlacementMap
 from tfidf_tpu.cluster.rebalance import Rebalancer
 from tfidf_tpu.cluster.registry import (ServiceRegistry,
@@ -93,14 +95,19 @@ log = get_logger("cluster.node")
 # They are ALSO the trace-propagation seams: when the calling thread
 # has an active span, its X-Trace-Id/X-Span-Id ride every outbound
 # request (explicit caller headers win on collision), so the trace
-# context crosses every leader->worker RPC by construction.
+# context crosses every leader->worker RPC by construction. Every
+# outbound request also stamps X-Proto-Version (cluster/protover.py)
+# beside X-Leader-Epoch where that rides, and the assembled headers
+# pass through the nemesis skew filter (filter_headers) so the
+# rolling-upgrade chaos can mask them per link.
 
 def http_get(url: str, timeout: float = 10.0,
              origin: str | None = None) -> bytes:
     global_nemesis.check_send(origin, url)
-    trace_h = propagation_headers()
-    req = urllib.request.Request(url, headers=trace_h) if trace_h \
-        else url
+    h = proto_headers()
+    h.update(propagation_headers())
+    h = global_nemesis.filter_headers(origin, url, h)
+    req = urllib.request.Request(url, headers=h)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return global_nemesis.filter_reply(origin, url, r.read())
 
@@ -180,8 +187,10 @@ class _ScatterClient:
                                       _socket.TCP_NODELAY, 1)
                     conns[base] = c
                 h = {"Content-Type": "application/json"}
+                h.update(proto_headers())
                 h.update(propagation_headers())
                 h.update(headers or {})
+                h = global_nemesis.filter_headers(self.origin, base, h)
                 c.request("POST", path, body=data, headers=h)
                 r = c.getresponse()
                 body = global_nemesis.filter_reply(self.origin, base,
@@ -204,7 +213,9 @@ class _ScatterClient:
                             r.getheader("X-Deadline-Exceeded") == "1"),
                         retry_after_s=ra_s,
                         fenced=(r.getheader(FENCE_REJECTED_HEADER)
-                                == "1"))
+                                == "1"),
+                        proto=(r.getheader(PROTO_REJECTED_HEADER)
+                               == "1"))
                 return body
             except RuntimeError:
                 raise
@@ -224,8 +235,10 @@ def http_post(url: str, data: bytes, content_type: str = "application/json",
               origin: str | None = None) -> bytes:
     global_nemesis.check_send(origin, url)
     h = {"Content-Type": content_type}
+    h.update(proto_headers())
     h.update(propagation_headers())
     h.update(headers or {})
+    h = global_nemesis.filter_headers(origin, url, h)
     req = urllib.request.Request(url, data=data, headers=h)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return global_nemesis.filter_reply(origin, url, r.read())
@@ -245,7 +258,10 @@ def http_get_stream(url: str, timeout: float = 30.0,
     a scripted partition could never cut the download path and the
     probe hop dropped out of the request trace.)"""
     global_nemesis.check_send(origin, url)
-    req = urllib.request.Request(url, headers=propagation_headers())
+    h = proto_headers()
+    h.update(propagation_headers())
+    h = global_nemesis.filter_headers(origin, url, h)
+    req = urllib.request.Request(url, headers=h)
     return urllib.request.urlopen(req, timeout=timeout)
 
 
@@ -368,6 +384,13 @@ class SearchNode(ScatterReadPlane):
                              if (self.config.result_cache_entries > 0
                                  and not self.config.unbounded_results)
                              else None)
+        # traffic-capture tap (utils/storage.py RequestLog): admitted
+        # /leader/start requests land in a durable replayable log when
+        # the knob names a path — bench.py --replay drives load from it
+        self.request_log = (storage.RequestLog(
+            self.config.replay_capture_path,
+            self.config.replay_capture_max)
+            if self.config.replay_capture_path else None)
         self._result_gen = 0
         self._result_gen_lock = threading.Lock()
         # cached role for /api/health: the real is_leader() is a
@@ -618,6 +641,8 @@ class SearchNode(ScatterReadPlane):
             self.batcher.stop()
         if self.scatter_batcher is not None:
             self.scatter_batcher.stop()
+        if self.request_log is not None:
+            self.request_log.close()
 
     # ---- worker search path (Worker.java:175-186) ----
 
@@ -2458,6 +2483,8 @@ class _NodeHandler(_HttpHandlerBase):
         node = self.node
         self._last_span = None
         try:
+            if not self._proto_gate(u.path):
+                return
             if u.path == "/api/health":
                 # the reserved observability lane: never admission-
                 # controlled, never blocks on coordination or serving
@@ -2467,6 +2494,7 @@ class _NodeHandler(_HttpHandlerBase):
                 # saturated bulk flood cannot queue ahead of this.
                 self._json({
                     "ok": True, "role": node._role,
+                    "proto_version": PROTO_VERSION,
                     "scatter_queue_depth": global_metrics.get(
                         "last_scatter_queue_depth", 0.0),
                     "admission": node.admission.snapshot()})
@@ -2557,6 +2585,8 @@ class _NodeHandler(_HttpHandlerBase):
         node = self.node
         self._last_span = None
         try:
+            if not self._proto_gate(u.path):
+                return
             if u.path == "/worker/process":
                 # same deadline refusal as the batched endpoint: the
                 # leader's per-query path propagates X-Deadline-Ms too,

@@ -66,22 +66,34 @@ class RpcStatusError(RuntimeError):
     epoch is STALE — a newer leader exists. Never retried (the epoch
     cannot grow back) and never a worker fault (refusing a deposed
     leader is the worker doing its job); the leader's correct reaction
-    is to step down (``SearchNode._fence_step_down``)."""
+    is to step down (``SearchNode._fence_step_down``).
+
+    ``proto`` marks the distinct wire-protocol rejection (426 +
+    ``X-Proto-Rejected: 1``, cluster/protover.py): the caller's
+    declared wire version is below the handler's compat floor. Never
+    retried (a binary's version cannot grow back mid-flight) and never
+    a worker fault (refusing an out-of-window peer during a rolling
+    upgrade is the handler doing its job — a breaker that opened on it
+    would amplify a routine upgrade into an outage)."""
 
     def __init__(self, url: str, status: int,
                  deadline_exceeded: bool = False,
                  retry_after_s: float | None = None,
-                 fenced: bool = False) -> None:
+                 fenced: bool = False,
+                 proto: bool = False) -> None:
         super().__init__(f"{url} -> {status}"
                          + (" (deadline exceeded)" if deadline_exceeded
                             else "")
                          + (" (fenced: stale leader epoch)" if fenced
-                            else ""))
+                            else "")
+                         + (" (proto: version outside compat window)"
+                            if proto else ""))
         self.url = url
         self.status = status
         self.deadline_exceeded = deadline_exceeded
         self.retry_after_s = retry_after_s
         self.fenced = fenced
+        self.proto = proto
 
 
 class CircuitOpenError(RuntimeError):
@@ -142,6 +154,15 @@ def retry_after_of(e: BaseException) -> float | None:
 # the leader must step down, not merely fail the request.
 _FENCE_STATUS = 403
 
+# the wire-protocol rejection status (cluster/protover.py
+# PROTO_STATUS): a handler refusing a peer whose declared wire version
+# is below its compat floor. 4xx on purpose — already non-retryable and
+# never a worker fault under the classifiers below; the explicit
+# ``proto`` flag and :func:`is_proto_rejection` make the distinct
+# consequence (surface version skew to the operator, never trip a
+# breaker) testable and graftcheck-checkable.
+_PROTO_STATUS = 426
+
 # disk full (utils/storage.py STORAGE_FULL_STATUS): an upload or
 # checkpoint hit ENOSPC. Deliberately NON-retryable (a full disk does
 # not drain on retry timescales; hammering it multiplies write load
@@ -168,6 +189,24 @@ def is_fence_rejection(e: BaseException) -> bool:
     return False
 
 
+def is_proto_rejection(e: BaseException) -> bool:
+    """A handler's wire-protocol rejection (426 +
+    ``X-Proto-Rejected: 1``): the calling peer's declared wire version
+    is below the handler's compat floor (cluster/protover.py). NEVER
+    retryable (the binary's version cannot change mid-flight) and NEVER
+    a worker fault (the handler is healthy and enforcing the window —
+    during a rolling upgrade this is routine, not an outage); callers
+    surface it as version skew instead of masking it as a failure."""
+    if isinstance(e, RpcStatusError):
+        return e.proto
+    if isinstance(e, urllib.error.HTTPError) and e.code == _PROTO_STATUS:
+        try:
+            return e.headers.get("X-Proto-Rejected") == "1"
+        except Exception:
+            return False
+    return False
+
+
 def is_retryable(e: BaseException) -> bool:
     """Default retry classifier: transient transport failures,
     gateway-transient statuses (502/503/504), and 429 admission sheds
@@ -187,6 +226,8 @@ def is_retryable(e: BaseException) -> bool:
         return False   # the budget cannot come back
     if is_fence_rejection(e):
         return False   # a stale epoch cannot become current again
+    if is_proto_rejection(e):
+        return False   # the binary's wire version cannot change mid-flight
     if isinstance(e, FaultInjected):
         return True
     if isinstance(e, RpcStatusError):
@@ -211,8 +252,14 @@ def is_worker_fault(e: BaseException) -> bool:
     that opened on sheds would amplify the very overload the shed is
     relieving (fast-fails would mark a live node dead). A leadership-
     fence 403 likewise: the WORKER is healthy — it is the calling
-    leader that is deposed (cluster/fencing.py)."""
+    leader that is deposed (cluster/fencing.py). And a wire-protocol
+    426 likewise: the handler is healthy — it is the CALLER that is
+    out of the compat window (cluster/protover.py); breakers opening
+    on routine rolling-upgrade skew would turn an upgrade into an
+    outage."""
     if is_fence_rejection(e):
+        return False
+    if is_proto_rejection(e):
         return False
     if isinstance(e, RpcStatusError):
         if e.deadline_exceeded:

@@ -57,15 +57,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tfidf_tpu.cluster.admission import (LANE_BULK, LANE_INTERACTIVE,
                                          AdmissionController, ResultCache)
+from tfidf_tpu.cluster.autopilot import Autopilot
 from tfidf_tpu.cluster.batcher import Coalescer
 from tfidf_tpu.cluster.coordination import (EPHEMERAL_SEQUENTIAL,
                                             NoNodeError)
 from tfidf_tpu.cluster.placement import PlacementFollower, PlacementMap
+from tfidf_tpu.cluster.protover import (PROTO_HEADER,
+                                        PROTO_REJECTED_HEADER,
+                                        PROTO_STATUS, PROTO_VERSION,
+                                        in_window, parse_version)
 from tfidf_tpu.cluster.registry import ServiceRegistry, read_leader_info
 from tfidf_tpu.cluster.resilience import (CircuitOpenError,
                                           ClusterResilience,
                                           DeadlineExpired, hedge_laggards)
 from tfidf_tpu.cluster.wire import unpack_hit_lists
+from tfidf_tpu.utils import storage as _storage
 from tfidf_tpu.utils.config import Config
 from tfidf_tpu.utils.faults import global_injector
 from tfidf_tpu.utils.logging import get_logger
@@ -843,6 +849,11 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
             sp = global_tracer.current()
             if sp is not None:
                 self.send_header(TRACE_HEADER, sp.trace_id)
+        # every reply declares this binary's wire-protocol version
+        # (cluster/protover.py) so either side of any exchange can
+        # detect skew; the protocol witness pins the stamp
+        if PROTO_HEADER not in headers:
+            self.send_header(PROTO_HEADER, str(PROTO_VERSION))
         self.end_headers()
         self.wfile.write(body)
 
@@ -952,6 +963,43 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
             return True
         return False
 
+    # ---- wire-protocol versioning (cluster/protover.py) ----
+
+    def _proto_gate(self, path: str) -> bool:
+        """The compat-window gate on the data planes. ``/leader/*`` and
+        ``/worker/*`` requests declaring a wire version below
+        ``proto_min_compat`` are answered with the DISTINCT status 426
+        + ``X-Proto-Rejected: 1`` — non-retryable and never a worker
+        fault (cluster/resilience.py ``is_proto_rejection``), so
+        rolling-upgrade skew surfaces honestly instead of tripping
+        breakers. A request with no version header is implicitly
+        version 1 (the pre-versioning wire); versions newer than ours
+        always pass (forward compatibility). Ops endpoints
+        (``/api/*``, metrics, traces) are deliberately ungated — an
+        operator can inspect any node whatever binary it runs. Returns
+        True when dispatch may proceed; False when the rejection reply
+        was already sent."""
+        # namespace compare, NOT path.startswith("/leader/"): a
+        # startswith literal in a handler method would register as a
+        # prefix ROUTE in the graftcheck endpoint extraction and make
+        # the whole namespace "explained" — the gate is not a route
+        ns = path.split("/", 2)[1] if path.startswith("/") else ""
+        if ns not in ("leader", "worker"):
+            return True
+        peer = parse_version(self.headers.get(PROTO_HEADER))
+        if in_window(peer, self.node.config.proto_min_compat):
+            return True
+        global_metrics.inc("proto_rejections")
+        self._send(PROTO_STATUS,
+                   json.dumps({
+                       "error": "wire-protocol version outside the "
+                                "compat window",
+                       "declared": peer,
+                       "min_compat": self.node.config.proto_min_compat,
+                       "server_version": PROTO_VERSION}).encode(),
+                   headers={PROTO_REJECTED_HEADER: "1"})
+        return False
+
     # ---- admission plumbing (cluster/admission.py) ----
 
     def _client_lane(self, default_lane: str) -> tuple[str, str]:
@@ -1041,6 +1089,16 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
             if sp is None:
                 return
             query = self._read_query()
+            # traffic-capture tap: every ADMITTED search lands in the
+            # durable request log (query + arrival offset + lane +
+            # client) when capture is armed — shed requests are
+            # deliberately not captured, so a replay reproduces the
+            # admitted workload, not the overload that was refused
+            rlog = getattr(node, "request_log", None)
+            if rlog is not None:
+                rlog.record(query, lane,
+                            self.headers.get("X-Client-Id")
+                            or self.client_address[0])
             result, health = node.leader_search_with_health(
                 query, lane=lane)
             # degraded marker: the body stays reference-compatible
@@ -1244,6 +1302,7 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
             sp = global_tracer.current()
             if sp is not None:   # stream replies bypass _send; same
                 self.send_header(TRACE_HEADER, sp.trace_id)  # contract
+            self.send_header(PROTO_HEADER, str(PROTO_VERSION))
             chunked = size is None
             if chunked:
                 self.send_header("Transfer-Encoding", "chunked")
@@ -1286,12 +1345,15 @@ class _RouterHandler(_HttpHandlerBase):
         router = self.node
         self._last_span = None
         try:
+            if not self._proto_gate(u.path):
+                return
             if u.path == "/api/health":
                 # the reserved observability lane: never admission-
                 # controlled, never blocks on coordination (view
                 # state is in-memory)
                 self._json({
                     "ok": True, "role": "router",
+                    "proto_version": PROTO_VERSION,
                     "placement": router.placement.view_snapshot(),
                     "scatter_queue_depth": global_metrics.get(
                         "last_router_scatter_queue_depth", 0.0),
@@ -1304,6 +1366,17 @@ class _RouterHandler(_HttpHandlerBase):
                 self._json({"leader": router.leader_url()})
             elif u.path == "/api/router":
                 self._json(router.router_snapshot())
+            elif u.path == "/api/autopilot":
+                # THIS router's autopilot state + decision audit (the
+                # POST kill switch still proxies to the leader). Same
+                # shape as the node's route, same observability-lane
+                # rule: never admission-controlled.
+                try:
+                    n = int(self._query_param(u, "recent") or 50)
+                except ValueError:
+                    n = 50
+                self._json({"autopilot": router.autopilot.snapshot(),
+                            "decisions": router.autopilot.decisions(n)})
             elif u.path == "/api/routers":
                 self._json(list_routers(router.coord))
             elif u.path == "/leader/download":
@@ -1322,6 +1395,8 @@ class _RouterHandler(_HttpHandlerBase):
         router = self.node
         self._last_span = None
         try:
+            if not self._proto_gate(u.path):
+                return
             if u.path == "/leader/start":
                 self._serve_search()
             elif u.path in self._PROXY_POSTS:
@@ -1422,6 +1497,22 @@ class QueryRouter(ScatterReadPlane):
                              if (self.config.router_cache_entries > 0
                                  and not self.config.unbounded_results)
                              else None)
+        # traffic-capture tap (utils/storage.py RequestLog): admitted
+        # /leader/start requests land in a durable replayable log when
+        # the knob names a path — bench.py --replay drives load from it
+        self.request_log = (_storage.RequestLog(
+            self.config.replay_capture_path,
+            self.config.replay_capture_max)
+            if self.config.replay_capture_path else None)
+        # per-router SLO autopilot (cluster/autopilot.py): the router
+        # owns its OWN admission, hedge, linger, and slow-trip knobs —
+        # the same live objects the leader's loop steers — so the
+        # closed loop runs here too (duck-typed over the shared
+        # scatter plane; the controllers never touch leader-only
+        # state). Paced by its own thread because the router has no
+        # reconcile sweep to ride.
+        self.autopilot = Autopilot(self)
+        self._autopilot_thread: threading.Thread | None = None
         self._role = "router"
         self._leader_cache: tuple[float, str | None] = (0.0, None)
         handler = type("Handler", (_RouterHandler,), {"node": self})
@@ -1506,10 +1597,25 @@ class QueryRouter(ScatterReadPlane):
             register_router(self.coord, self.url)
         except Exception as e:
             log.warning("router registration failed", err=repr(e))
+        if self.autopilot.enabled:
+            self._autopilot_thread = threading.Thread(
+                target=self._autopilot_loop, daemon=True,
+                name=f"router-autopilot-{self.port}")
+            self._autopilot_thread.start()
         global_metrics.inc("router_started")
         log.info("router started", url=self.url,
                  view=self.placement.view_snapshot())
         return self
+
+    def _autopilot_loop(self) -> None:
+        """The router's pacing thread for ``Autopilot.maybe_run`` (the
+        leader rides its reconcile sweep; a router has none)."""
+        while not self._stopping:
+            time.sleep(0.1)
+            try:
+                self.autopilot.maybe_run()
+            except Exception as e:
+                log.warning("router autopilot pass failed", err=repr(e))
 
     def stop(self) -> None:
         self._stopping = True
@@ -1520,6 +1626,8 @@ class QueryRouter(ScatterReadPlane):
         self._slice_pool.shutdown(wait=False)
         if self.scatter_batcher is not None:
             self.scatter_batcher.stop()
+        if self.request_log is not None:
+            self.request_log.close()
 
     # ---- downloads: probe workers, then the leader's local store ----
 

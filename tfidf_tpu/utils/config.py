@@ -483,6 +483,30 @@ class Config:
     # /api/trace) and counts in `slow_queries`. 0 disables.
     trace_slow_query_ms: float = 0.0
 
+    # --- wire-protocol versioning (cluster/protover.py) ---
+    # Compat-window floor for the data planes (/leader/*, /worker/*): a
+    # request declaring a wire-protocol version below this is answered
+    # 426 + X-Proto-Rejected: 1 (distinct, non-retryable, never a
+    # worker fault). Requests with no version header are implicitly
+    # version 1 (the pre-versioning wire), so the default floor keeps
+    # old binaries interoperating; raise it only after the whole fleet
+    # runs a binary at or above the new floor. Versions ABOVE ours are
+    # always accepted (forward compatibility — no ceiling).
+    proto_min_compat: int = 1
+
+    # --- traffic capture/replay (utils/storage.py RequestLog) ---
+    # Durable request-log path for admitted /leader/start traffic
+    # (query + arrival offset + lane + client id), written through the
+    # storage seam's CRC-framed append log so a torn tail truncates
+    # cleanly instead of corrupting the capture. Empty disables the
+    # tap. `bench.py --replay` replays a captured log with original
+    # inter-arrival spacing so perf claims run against production-
+    # shaped traffic instead of synthetic zipf.
+    replay_capture_path: str = ""
+    # Bound on captured entries per log (memory- and disk-bounded like
+    # the trace ring); the tap stops appending once reached.
+    replay_capture_max: int = 100000
+
     # --- ingest ---
     # C++ tokenize+count+id-map fast path (tfidf_tpu/native); falls back
     # to the pure-Python analyzer when no compiler is available or for

@@ -37,7 +37,11 @@ This is the one seam every durable byte now goes through:
   flush rounds, the coalescer discipline applied to durability);
 - :class:`CrcLedger` — name → CRC32 record for a store of raw
   documents (the leader's ``placed_docs``), the reference the
-  integrity scrub verifies replicas against.
+  integrity scrub verifies replicas against;
+- :class:`RequestLog` — the durable traffic-capture log (admitted
+  ``/leader/start`` queries + arrival offsets + lanes), CRC-framed
+  per line so a torn tail truncates cleanly; ``bench.py --replay``
+  replays it as production-shaped load.
 
 Nemesis rules are scriptable in-process (``global_storage.arm(...)``)
 and via the ``TFIDF_STORAGE_NEMESIS`` env var (a JSON rule list) so
@@ -55,6 +59,7 @@ import json
 import os
 import random
 import threading
+import time
 import zlib
 
 from tfidf_tpu.utils.faults import global_injector
@@ -694,3 +699,112 @@ class CrcLedger:
             log.warning("crc ledger flush failed", err=repr(e))
             return False
         return True
+
+
+# ---------------------------------------------------------------------------
+# traffic capture — the replayable request log
+# ---------------------------------------------------------------------------
+
+class RequestLog:
+    """Durable, replayable capture of front-door search traffic: one
+    record per ADMITTED ``/leader/start`` request — query text, arrival
+    offset (monotonic seconds since the log opened), admission lane,
+    and client id — so perf claims can replay production-shaped
+    traffic instead of synthetic zipf (``bench.py --replay``).
+
+    Framing is the WAL's discipline applied to capture: each record is
+    one ``<crc32-hex> <compact-json>\\n`` line over an append handle
+    held by this class (the capture log IS the seam for its own
+    CRC-framed lines, the ``cluster/wal.py`` precedent — pinned in the
+    graftcheck storageseam allowlist), and :meth:`read` stops at the
+    first frame whose CRC fails, so a torn tail (or injected bit rot —
+    reads go through :func:`read_bytes`) truncates cleanly instead of
+    replaying a damaged query. Appends are buffered with a periodic
+    flush; :meth:`flush`/:meth:`close` drive the buffered tail through
+    the same fsync fault point the rest of the seam uses. Capture is an
+    observability artifact, not acked state — flush-on-close is the
+    durability contract, not fsync-before-ack."""
+
+    _FLUSH_EVERY = 256
+
+    def __init__(self, path: str, max_entries: int = 100000) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+        self._max = max(0, int(max_entries))
+        self._count = 0
+        self._t0 = time.monotonic()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "ab")
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def record(self, query: str, lane: str, client: str = "") -> bool:
+        """Append one admitted request; False once the entry bound is
+        reached (bounded like the trace ring) or the log is closed."""
+        line = json.dumps(
+            {"t": round(time.monotonic() - self._t0, 6),
+             "query": query, "lane": lane, "client": client},
+            separators=(",", ":")).encode("utf-8")
+        framed = b"%08x %s\n" % (zlib.crc32(line) & 0xFFFFFFFF, line)
+        with self._lock:
+            if self._f is None or self._count >= self._max:
+                return False
+            self._count += 1
+            try:
+                self._f.write(framed)
+                if self._count % self._FLUSH_EVERY == 0:
+                    self._f.flush()
+            except OSError as e:
+                _enospc_seen(e)
+                log.warning("request-log append failed", err=repr(e))
+                return False
+        global_metrics.inc("capture_records")
+        return True
+
+    def flush(self, fsync: bool = True) -> None:
+        """Drive the buffered tail to disk (the fsync-EIO fault point,
+        like every seam fsync)."""
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.flush()
+            if fsync:
+                global_injector.check("storage.fsync")
+                if global_storage.match("fsync", self._path) is not None:
+                    raise DiskFault(errno.EIO, "injected: fsync failed",
+                                    self._path)
+                os.fsync(self._f.fileno())
+                global_metrics.inc("storage_fsyncs")
+
+    def close(self) -> None:
+        try:
+            self.flush(fsync=True)
+        except OSError as e:
+            log.warning("request-log flush-on-close failed", err=repr(e))
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Decode a captured log: every intact record in arrival order,
+        truncated cleanly at the first frame whose CRC fails (torn
+        tail / bit rot — reads go through the seam, so the disk
+        nemesis can damage them and this contract is testable)."""
+        out: list[dict] = []
+        for line in read_bytes(path).splitlines():
+            if not line.strip():
+                continue
+            try:
+                crc_hex, payload = line.split(b" ", 1)
+                if int(crc_hex, 16) != (zlib.crc32(payload) & 0xFFFFFFFF):
+                    break
+                out.append(json.loads(payload.decode("utf-8")))
+            except ValueError:
+                break
+        return out

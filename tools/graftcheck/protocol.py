@@ -34,6 +34,17 @@ hand. This module makes the contract a build gate, four passes:
    (``global_nemesis.check_send``) and trace-propagating
    (``propagation_headers``) — the "same shared seams" invariant that
    previously existed only as prose in the PR 8/9 descriptions.
+5. **version surface** — the wire contract is versioned
+   (``cluster/protover.py``): every README wire-table row carries a
+   version window (``since–`` or ``since–until``), the README declares
+   the current wire version, and the whole machine-extracted surface
+   (routes × methods × statuses × contract headers) is pinned as a
+   ``contract fingerprint``. Changing ANY wire surface moves the
+   fingerprint and fails this pass until the change is reviewed —
+   re-pin the fingerprint, stamp the new/changed rows' windows, and
+   bump ``PROTO_VERSION`` (or add a compat shim) to clear it. The
+   proto-rejection status is also cross-checked against
+   ``resilience._PROTO_STATUS`` exactly like the fence status.
 
 Everything is pure AST (the package is parsed, never imported); the
 runtime half is :mod:`tools.graftcheck.protocol_witness`, which records
@@ -45,6 +56,7 @@ lockdep-style mutual validation.
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import re
 from dataclasses import dataclass, field
@@ -63,6 +75,7 @@ CONTRACT_HEADERS = frozenset({
     "X-Trace-Id", "X-Span-Id", "X-Route-Epoch", "X-Route-Generation",
     "X-Scatter-Degraded", "X-Deadline-Exceeded", "X-Fence-Rejected",
     "X-Fence-Epoch", "X-Shed-Reason", "Retry-After", "Connection",
+    "X-Proto-Version", "X-Proto-Rejected",
 })
 
 _MUTATING_WORKER_PREFIXES = ("/worker/upload", "/worker/delete")
@@ -941,6 +954,160 @@ def check_seams(tree: SourceTree) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 5. version surface
+# ---------------------------------------------------------------------------
+
+# a version-window cell: "1–" (since 1, still current) or "1–1"
+# (retired at 1). MUST be a non-last cell — the statuses parser reads
+# the row's last cell — and must never contain backticks or 3-digit
+# numbers (they would register as endpoints/statuses).
+_VERSION_WINDOW_RE = re.compile(r"^(\d+)\s*[–-]\s*(\d+)?$")
+_README_VERSION_RE = re.compile(
+    r"current wire version[^0-9]{0,40}(\d+)", re.I)
+_README_FPRINT_RE = re.compile(
+    r"contract fingerprint[^`]{0,40}`([0-9a-f]{12})`", re.I)
+
+
+def contract_fingerprint(tree: SourceTree) -> str:
+    """sha256[:12] over the machine-extracted wire surface: every
+    dispatched route (path, prefix-ness, methods), every constant reply
+    status, and the contract-header vocabulary. Any change to what the
+    cluster serves or stamps moves this value — the README pin is the
+    review gate."""
+    lines = []
+    for r in sorted(served_routes(tree),
+                    key=lambda r: (r.path, r.prefix)):
+        lines.append(f"{r.path}{'*' if r.prefix else ''} "
+                     f"{','.join(sorted(r.methods))}")
+    statuses = sorted({s for s, *_rest in _status_sites(tree)})
+    lines.append("statuses " + ",".join(str(s) for s in statuses))
+    lines.append("headers " + ",".join(sorted(CONTRACT_HEADERS)))
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()[:12]
+
+
+def _readme_row_windows(root: str):
+    """(endpoints, (since, until_or_None) | None) for every data row of
+    the README wire table that names at least one endpoint."""
+    path = os.path.join(root, "README.md")
+    if not os.path.isfile(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(r"^## Wire contract$(.*?)(?=^## |\Z)", text,
+                  re.M | re.S)
+    if m is None:
+        return []
+    rows = []
+    for line in m.group(1).splitlines():
+        line = line.strip()
+        if not line.startswith("|") or set(line) <= {"|", "-", " ", ":"}:
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        eps = re.findall(r"`(/[^`]*)`", " ".join(cells[:-1]))
+        if not eps:
+            continue
+        window = None
+        for c in cells[:-1]:
+            wm = _VERSION_WINDOW_RE.match(c)
+            if wm is not None:
+                window = (int(wm.group(1)),
+                          int(wm.group(2)) if wm.group(2) else None)
+                break
+        rows.append((eps, window))
+    return rows
+
+
+def check_version_surface(tree: SourceTree, root: str) -> list[Finding]:
+    """The versioned-wire gate: PROTO_VERSION ↔ README declaration,
+    per-row version windows, the pinned contract fingerprint, and the
+    proto-status classifier cross-check. Returns nothing for trees
+    without ``cluster/protover.py`` (mini fixtures opt in by including
+    one)."""
+    if "cluster.protover" not in tree.modules:
+        return []   # mini fixture trees — real-tree gate only
+    pv = tree.modules["cluster.protover"]
+    consts = _module_int_consts(tree, "cluster.protover")
+    proto_version = consts.get("PROTO_VERSION")
+    if proto_version is None:
+        return [Finding(
+            "protocol", "protocol:version:extraction-empty",
+            "PROTO_VERSION not found in cluster/protover.py — the "
+            "version-surface pass went stale", pv.relpath, 1)]
+    out: list[Finding] = []
+    res = tree.modules.get("cluster.resilience")
+    proto_status = consts.get("PROTO_STATUS")
+    if res is not None and proto_status is not None:
+        res_status = _module_int_consts(
+            tree, "cluster.resilience").get("_PROTO_STATUS")
+        if res_status is not None and res_status != proto_status:
+            out.append(Finding(
+                "protocol", "protocol:version:proto-status-mismatch",
+                f"protover.PROTO_STATUS ({proto_status}) != "
+                f"resilience._PROTO_STATUS ({res_status}) — the "
+                f"version rejection would be misclassified (retried, "
+                f"or charged to a worker's breaker)", res.relpath, 1))
+    path = os.path.join(root, "README.md")
+    if not os.path.isfile(path):
+        return out   # check_wire_table reports the missing README
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    m = _README_VERSION_RE.search(text)
+    if m is None:
+        out.append(Finding(
+            "protocol", "protocol:version:undeclared",
+            "README does not declare the current wire version "
+            "('current wire version: N') — operators cannot check a "
+            "binary against the compat window", "README.md", 1))
+    elif int(m.group(1)) != proto_version:
+        out.append(Finding(
+            "protocol", "protocol:version:declared-mismatch",
+            f"README declares wire version {m.group(1)}, "
+            f"cluster/protover.py says {proto_version} — the doc and "
+            f"the code disagree on what the fleet speaks",
+            "README.md", 1))
+    fp = contract_fingerprint(tree)
+    fm = _README_FPRINT_RE.search(text)
+    if fm is None:
+        out.append(Finding(
+            "protocol", "protocol:version:fingerprint-unpinned",
+            f"README pins no contract fingerprint — pin "
+            f"`{fp}` so any wire-surface change fails the build "
+            f"until reviewed", "README.md", 1))
+    elif fm.group(1) != fp:
+        out.append(Finding(
+            "protocol", "protocol:version:fingerprint-drift",
+            f"wire surface changed without a reviewed version bump: "
+            f"code extracts fingerprint {fp}, README pins "
+            f"{fm.group(1)} — stamp the changed rows' version "
+            f"windows, bump PROTO_VERSION (or add a compat shim), "
+            f"then re-pin", "README.md", 1))
+    for eps, window in _readme_row_windows(root):
+        key = eps[0]
+        if window is None:
+            out.append(Finding(
+                "protocol", f"protocol:version:row-unversioned:{key}",
+                f"README wire-table row {key!r} carries no version "
+                f"window ('1–' / '2–' / '1–1') — every wire surface "
+                f"must say when it entered (and left) the contract",
+                "README.md", 1))
+            continue
+        since, until = window
+        if since > proto_version:
+            out.append(Finding(
+                "protocol", f"protocol:version:row-future:{key}",
+                f"README row {key!r} claims since-version {since} but "
+                f"the code's PROTO_VERSION is {proto_version} — a row "
+                f"cannot enter the contract in a version that does "
+                f"not exist yet", "README.md", 1))
+        if until is not None and until < since:
+            out.append(Finding(
+                "protocol", f"protocol:version:row-inverted:{key}",
+                f"README row {key!r} has an inverted version window "
+                f"{since}–{until}", "README.md", 1))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # contract for the runtime witness + driver
 # ---------------------------------------------------------------------------
 
@@ -972,4 +1139,4 @@ def build_contract(root: str,
 def analyze(tree: SourceTree, root: str) -> list[Finding]:
     return (check_endpoints(tree, root) + check_wire_table(tree, root)
             + check_headers(tree) + check_statuses(tree, root)
-            + check_seams(tree))
+            + check_seams(tree) + check_version_surface(tree, root))

@@ -14,7 +14,9 @@ run. Each side validates the other:
   static contract cannot explain (an endpoint the route extraction
   missed, a status outside the reviewed set, a front-door 429/503
   without ``Retry-After``, a ``/leader/start`` 200 without its route
-  stamp, a traced worker RPC whose reply lost ``X-Trace-Id``) — and,
+  stamp, a traced worker RPC whose reply lost ``X-Trace-Id``, any
+  reply on either plane missing its ``X-Proto-Version`` wire-version
+  stamp) — and,
   lockdep-style in the other direction, on statically-claimed contract
   surface the run never exercised (``require_exercised``).
 
@@ -219,6 +221,13 @@ class ProtocolWitness:
             if ex.status not in c.statuses:
                 out.append(f"status outside the reviewed contract set: "
                            f"{where}")
+            if ex.status != 404 and "X-Proto-Version" not in ex.headers:
+                # every versioned-wire reply (both planes) names the
+                # version it speaks (cluster/protover.py); 404s may
+                # come from the http.server default error path, which
+                # is outside the stamping seams
+                out.append(f"reply without its wire-version stamp "
+                           f"(X-Proto-Version): {where}")
             if ex.plane == "front" and ex.status in (429, 503) \
                     and "Retry-After" not in ex.headers:
                 out.append(f"shed reply without Retry-After: {where}")

@@ -8,7 +8,8 @@ be bypassed: a new feature that writes durable state with a raw
 torn-write and silent-bit-rot windows the seam exists to close, and the
 disk nemesis cannot inject faults into a path it never sees.
 
-This pass flags, anywhere in the package OUTSIDE ``utils/storage.py``:
+This pass flags, anywhere in the package — ``utils/storage.py``
+INCLUDED — :
 
 - ``open(...)`` with a write/append/update mode literal,
 - ``np.savez`` / ``np.savez_compressed`` (direct or via a handle),
@@ -20,6 +21,15 @@ seam for its own CRC-framed log; the native-build ``.so`` cache; the
 CLI's operator-requested trace export). Anything new fails the build
 until it is either migrated onto the seam or reviewed into the
 allowlist — the same contract as every other graftcheck pass.
+
+The seam module itself used to be blanket-skipped, which hid its own
+primitives AND any new durable-write class that happened to live there
+(the PR 16 capture log was the near-miss) behind incidental
+non-detection. It is now scanned like everything else: the seam's
+atomic-write/rename primitives and the ``RequestLog`` capture-log
+append handle are each pinned in the allowlist with their reviewed
+discipline spelled out — runtime artifacts (trace exports, capture
+logs) are EXPLICIT exceptions, never silent ones.
 """
 
 from __future__ import annotations
@@ -63,7 +73,6 @@ def analyze(tree: SourceTree, root: str = ".") -> list[Finding]:
     for mi in tree.modules.values():
         if mi.name == SEAM_MODULE:
             found_any = True   # the seam exists; extraction is alive
-            continue
         # enclosing def-chain names for stable keys (no line numbers)
         chains: dict[int, list[str]] = {}
 

@@ -112,6 +112,19 @@
 #                     router — fidelity gated in-run (every captured
 #                     admitted request must replay admitted); writes
 #                     BENCH_r10.json
+#   make bench-hybrid r17 hybrid-retrieval bench: batched dense q/s
+#                     (with the achieved model-flop rate) beside the
+#                     sparse plane on the same engine/stream, a
+#                     sparse/dense/hybrid latency table, and
+#                     fused-vs-sparse relevance deltas (MRR@10 /
+#                     recall@10) on the synthetic MS MARCO-style
+#                     slice; backend stamped honestly; writes
+#                     BENCH_r11.json
+#   make chaos-hybrid slow hybrid chaos job: zipfian hybrid/dense
+#                     load with a worker's data plane killed
+#                     mid-scatter — every reply exact or honestly
+#                     X-Scatter-Degraded, never silently partial
+#                     (tests/test_hybrid.py -m slow)
 
 #   make trace-demo   zero-to-aha for the tracing layer: spin a small
 #                     in-process cluster, kill a worker mid-request,
@@ -145,9 +158,9 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition chaos-autopilot chaos-router \
-        chaos-powerloss chaos-upgrade scrub \
+        chaos-powerloss chaos-upgrade chaos-hybrid scrub \
         faults bench bench-overload bench-routers bench-kernel \
-        bench-replay probe-overlap \
+        bench-replay bench-hybrid probe-overlap \
         graftcheck lockdep protocol-witness check trace-demo
 
 test:
@@ -171,18 +184,20 @@ lockdep:
 	  tests/test_observability.py tests/test_autopilot.py \
 	  tests/test_router.py tests/test_storage.py \
 	  tests/test_commit_stats.py tests/test_upgrade.py \
-	  tests/test_graftcheck.py \
+	  tests/test_graftcheck.py tests/test_hybrid.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 # Suite choice: test_router drives the stateless-router tier (reads,
-# proxied writes, sheds, downloads) and test_partition drives the
-# fence/nemesis wire surface — together they exercise the core
-# scatter/mutation contract rows (CORE_EXERCISED in
-# tools/graftcheck/protocol_witness.py) the witness requires.
+# proxied writes, sheds, downloads), test_partition drives the
+# fence/nemesis wire surface, and test_hybrid drives the staged v3
+# surface (mode/fusion fields, 2n replies, X-Search-Stages) — together
+# they exercise the core scatter/mutation contract rows
+# (CORE_EXERCISED in tools/graftcheck/protocol_witness.py) the
+# witness requires.
 protocol-witness:
 	JAX_PLATFORMS=cpu GRAFTCHECK_PROTOCOL=1 python -m pytest \
 	  tests/test_router.py tests/test_partition.py \
-	  tests/test_graftcheck.py \
+	  tests/test_graftcheck.py tests/test_hybrid.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 trace-demo:
@@ -220,6 +235,9 @@ chaos-powerloss:
 chaos-upgrade:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_upgrade.py $(PYTEST_FLAGS) -m slow
 
+chaos-hybrid:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_hybrid.py $(PYTEST_FLAGS) -m slow
+
 scrub:
 	python -m tfidf_tpu scrub
 
@@ -243,3 +261,6 @@ bench-kernel:
 
 bench-replay:
 	BENCH_OUT=BENCH_r10.json python bench.py --replay
+
+bench-hybrid:
+	BENCH_OUT=BENCH_r11.json python bench.py --hybrid

@@ -2672,6 +2672,189 @@ def kernel_main() -> None:
     _emit_validated(result, headline)
 
 
+# --------------------------------------------------------------------------
+# hybrid retrieval (BENCH_r11.json): the dense plane beside the sparse
+# one (ISSUE 17) — batched dense q/s with the achieved matmul flop
+# rate, a sparse/dense/hybrid latency table on the SAME engine and
+# query stream, and fused-vs-sparse relevance deltas on the synthetic
+# MS MARCO-style slice (tfidf_tpu/utils/textgen.py: real-English
+# lexicon, zipfian draws, passage-length docs)
+# --------------------------------------------------------------------------
+
+HY_DOCS = 20_000
+HY_AVG_LEN = 60
+HY_BATCH = 256
+HY_BATCHES = 4
+HY_REL_QUERIES = 200
+
+
+def bench_hybrid(rng) -> dict:
+    import jax
+
+    from tfidf_tpu.cluster import fusion
+    from tfidf_tpu.engine import Engine
+    from tfidf_tpu.utils.config import Config
+    from tfidf_tpu.utils.textgen import RealisticCorpus, harvest_lexicon
+
+    t0 = time.perf_counter()
+    words, _ = harvest_lexicon()
+    gen = RealisticCorpus(rng, words)
+    texts = [gen.make_text(HY_AVG_LEN) for _ in range(HY_DOCS)]
+    log(f"[hy] {HY_DOCS} passage docs from a {len(words)}-word "
+        f"lexicon in {time.perf_counter()-t0:.0f}s")
+
+    # dim 256 (vs the 64 default): the hash projection's distortion of
+    # the true bag cosine shrinks ~1/sqrt(dim), and relevance is the
+    # point of this round — dense quality here is the PROJECTION's,
+    # the learned-encoder seam stays pluggable (register_embedder)
+    cfg = Config(query_batch=HY_BATCH, embedding_dim=256)
+    engine = Engine(cfg)
+    t0 = time.perf_counter()
+    for i, text in enumerate(texts):
+        engine.ingest_text(f"d{i}.txt", text)
+    engine.commit()
+    log(f"[hy] ingest+commit (sparse + {cfg.embedding_dim}-dim "
+        f"embedding column) in {time.perf_counter()-t0:.1f}s")
+
+    def make_query() -> str:
+        k = int(rng.integers(2, 5))
+        idx = rng.choice(len(words), size=k, p=gen.p)
+        return " ".join(words[i] for i in idx)
+
+    queries = [make_query() for _ in range(HY_BATCH * (HY_BATCHES + 2))]
+    stream = queries[2 * HY_BATCH:]
+
+    def fused_lists(qs, method):
+        sp_hits = engine.search_batch(qs, k=TOP_K)
+        dn_hits = engine.search_dense_batch(qs, k=TOP_K)
+        out = []
+        for sh, dh in zip(sp_hits, dn_hits):
+            merged = fusion.fuse(
+                {h.name: h.score for h in sh}, dict(dh),
+                method=method, k=TOP_K, rrf_k=cfg.fusion_rrf_k,
+                w_sparse=cfg.fusion_weight_sparse,
+                w_dense=cfg.fusion_weight_dense)
+            out.append(fusion.rank_list(merged, TOP_K))
+        return out
+
+    # warm every executable (sparse ELL, dense matmul) off the clock
+    engine.search_batch(queries[:HY_BATCH], k=TOP_K)
+    engine.search_dense_batch(queries[:HY_BATCH], k=TOP_K)
+    fused_lists(queries[HY_BATCH:2 * HY_BATCH], "rrf")
+
+    def timed(run):
+        lats = []
+        for b in range(HY_BATCHES):
+            batch = stream[b * HY_BATCH:(b + 1) * HY_BATCH]
+            t = time.perf_counter()
+            run(batch)
+            lats.append(time.perf_counter() - t)
+        n = HY_BATCH * HY_BATCHES
+        return {"qps": round(n / sum(lats), 1),
+                "batch_ms_p50": round(
+                    float(np.median(lats)) * 1e3, 2),
+                "per_query_us": round(sum(lats) / n * 1e6, 1)}
+
+    lat_sparse = timed(lambda b: engine.search_batch(b, k=TOP_K))
+    lat_dense = timed(lambda b: engine.search_dense_batch(b, k=TOP_K))
+    lat_hybrid = timed(lambda b: fused_lists(b, "rrf"))
+    # achieved matmul flop rate from MODEL flops (2 * B * live_docs *
+    # dim — padding excluded, so the number cannot flatter the kernel)
+    dim = cfg.embedding_dim
+    flops_q = 2.0 * HY_DOCS * dim
+    gflops = lat_dense["qps"] * flops_q / 1e9
+    log(f"[hy] sparse {lat_sparse['qps']} q/s, dense "
+        f"{lat_dense['qps']} q/s ({gflops:.2f} GFLOP/s model flops), "
+        f"hybrid {lat_hybrid['qps']} q/s (batch={HY_BATCH})")
+
+    # fused-vs-sparse relevance on queries with a KNOWN target doc:
+    # 3-4 tokens sampled from one passage; the metric is the target's
+    # reciprocal rank in the top-10 (MRR@10) and hit rate (recall@10)
+    def relevance(run_lists) -> tuple:
+        mrr = hits = 0.0
+        for qi, (q, want) in enumerate(rel_queries):
+            ranked = rel_results[run_lists][qi]
+            names = [n for n, _ in ranked[:TOP_K]]
+            if want in names:
+                hits += 1.0
+                mrr += 1.0 / (names.index(want) + 1)
+        n = len(rel_queries)
+        return round(mrr / n, 4), round(hits / n, 4)
+
+    rel_queries = []
+    doc_ids = rng.choice(HY_DOCS, size=HY_REL_QUERIES, replace=False)
+    for d in doc_ids:
+        toks = [t for t in texts[int(d)].split()
+                if len(t) > 3][:40]
+        if len(toks) < 4:
+            continue
+        pick = rng.choice(len(toks), size=int(rng.integers(3, 5)),
+                          replace=False)
+        rel_queries.append((" ".join(toks[i] for i in pick),
+                            f"d{int(d)}.txt"))
+    qs = [q for q, _ in rel_queries]
+    rel_results = {
+        "sparse": [[(h.name, h.score) for h in hs]
+                   for hs in engine.search_batch(qs, k=TOP_K)],
+        "dense": engine.search_dense_batch(qs, k=TOP_K),
+        "hybrid_rrf": fused_lists(qs, "rrf"),
+        "hybrid_wsum": fused_lists(qs, "wsum"),
+    }
+    rel = {mode: {"mrr_at_10": m, "recall_at_10": r}
+           for mode, (m, r) in
+           ((mode, relevance(mode)) for mode in rel_results)}
+    log(f"[hy] relevance over {len(rel_queries)} known-target "
+        f"queries: " + ", ".join(
+            f"{m} mrr={v['mrr_at_10']}" for m, v in rel.items()))
+
+    return {
+        "docs": HY_DOCS, "batch": HY_BATCH, "top_k": TOP_K,
+        "embedding": engine.dense_stats(),
+        "latency": {"sparse": lat_sparse, "dense": lat_dense,
+                    "hybrid_rrf": lat_hybrid},
+        "dense_model_gflops_per_s": round(gflops, 3),
+        "relevance": rel,
+        "relevance_queries": len(rel_queries),
+        "backend": jax.default_backend(),
+    }
+
+
+def hybrid_main() -> None:
+    """Standalone entry (``python bench.py --hybrid``; ``make
+    bench-hybrid`` sets ``BENCH_OUT=BENCH_r11.json``). The headline is
+    the batched dense q/s; ``vs_baseline`` is dense q/s over sparse
+    q/s on the SAME engine/stream (how much the new plane costs
+    relative to the plane it rides beside). The backend is stamped
+    honestly per the r09 precedent — a CPU-control run says ``cpu``
+    and the flop rate is MODEL flops, never padded-shape flops."""
+    os.environ.setdefault("BENCH_OUT", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r11.json"))
+    rng = np.random.default_rng(SEED)
+    hy = bench_hybrid(rng)
+    result = {
+        "metric": "hybrid_dense_batched_qps_20k_docs",
+        "value": hy["latency"]["dense"]["qps"],
+        "unit": "queries/sec",
+        "vs_baseline": round(hy["latency"]["dense"]["qps"]
+                             / hy["latency"]["sparse"]["qps"], 3),
+        "extra": hy,
+    }
+    headline = {
+        "dense_qps": hy["latency"]["dense"]["qps"],
+        "sparse_qps": hy["latency"]["sparse"]["qps"],
+        "hybrid_qps": hy["latency"]["hybrid_rrf"]["qps"],
+        "dense_model_gflops_per_s": hy["dense_model_gflops_per_s"],
+        "mrr_sparse": hy["relevance"]["sparse"]["mrr_at_10"],
+        "mrr_dense": hy["relevance"]["dense"]["mrr_at_10"],
+        "mrr_hybrid_rrf":
+            hy["relevance"]["hybrid_rrf"]["mrr_at_10"],
+        "mrr_hybrid_wsum":
+            hy["relevance"]["hybrid_wsum"]["mrr_at_10"],
+        "backend": hy["backend"],
+    }
+    _emit_validated(result, headline)
+
+
 def _validated_json(obj: dict, what: str) -> str:
     """Serialize + re-parse + key-check; exit 1 LOUDLY on any problem
     instead of leaving a broken artifact behind (PR-2 self-validation)."""
@@ -2810,5 +2993,7 @@ if __name__ == "__main__":
         routers_main()
     elif "--kernel" in sys.argv:
         kernel_main()
+    elif "--hybrid" in sys.argv:
+        hybrid_main()
     else:
         main()

@@ -57,6 +57,10 @@ def test_k8s_manifest_structure():
     for name in env:
         assert name.startswith("TFIDF_")
         assert name[len("TFIDF_"):] in fields, name
+    # the dense plane is an explicit per-fleet capacity decision, not
+    # an inherited default (off => dense/hybrid 400 loudly)
+    assert env["TFIDF_EMBEDDING_ENABLED"]["value"] == "true"
+    assert env["TFIDF_EMBEDDING_MODEL"]["value"] == "hash"
 
     mounts = {m["name"]: m["mountPath"]
               for m in pod["containers"][0]["volumeMounts"]}

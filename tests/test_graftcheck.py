@@ -946,6 +946,69 @@ class H(BaseHTTPRequestHandler):
         tree3 = _mini_tree(tmp_path, files)
         assert not protocol.check_version_surface(tree3, str(tmp_path))
 
+    def test_additive_surface_requires_bump_and_repin(self, tmp_path):
+        """The PR 17 review loop: growing the wire surface (a new
+        served route, the hybrid ``mode`` story) moves the fingerprint,
+        so the OLD pin fails until the change is reviewed — version
+        bumped, new row windowed at the new version, fingerprint
+        re-pinned. The reviewed tree is clean; a row windowed BEYOND
+        the declared version stays a finding."""
+        files = {
+            "cluster/protover.py":
+                "PROTO_VERSION = 2\nPROTO_STATUS = 426\n",
+            "cluster/resilience.py":
+                "_TRANSIENT_STATUSES = frozenset({503})\n"
+                "_FENCE_STATUS = 403\n_PROTO_STATUS = 426\n",
+            "cluster/h.py": '''
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def _send(self, code, body):
+        self.send_response(code)
+
+    def do_POST(self):
+        if self.path == "/worker/x":
+            self._send(200, b"ok")
+'''}
+        v2_fp = protocol.contract_fingerprint(_mini_tree(tmp_path,
+                                                         files))
+        # the surface grows: a second route appears (additive, like
+        # the staged-mode plan) but the README still pins the v2 world
+        files["cluster/h.py"] = files["cluster/h.py"].replace(
+            '            self._send(200, b"ok")',
+            '            self._send(200, b"ok")\n'
+            '        if self.path == "/worker/staged":\n'
+            '            self._send(200, b"ok")')
+        tree = _mini_tree(tmp_path, files)
+        assert protocol.contract_fingerprint(tree) != v2_fp
+        (tmp_path / "README.md").write_text(
+            "## Wire contract\n\n"
+            "| endpoint | methods | since | statuses |\n"
+            "|---|---|---|---|\n"
+            "| `/worker/x` | POST | 1– | 200 |\n"
+            "| `/worker/staged` | POST | 3– | 200 |\n\n"
+            "## Versioning\n\nCurrent wire version: **2**.\n"
+            f"Contract fingerprint: `{v2_fp}`.\n")
+        keys = {f.key
+                for f in protocol.check_version_surface(tree,
+                                                        str(tmp_path))}
+        assert "protocol:version:fingerprint-drift" in keys
+        assert "protocol:version:row-future:/worker/staged" in keys
+        # the review: bump the version, keep the 3– window, re-pin
+        files["cluster/protover.py"] = (
+            "PROTO_VERSION = 3\nPROTO_STATUS = 426\n")
+        tree = _mini_tree(tmp_path, files)
+        (tmp_path / "README.md").write_text(
+            "## Wire contract\n\n"
+            "| endpoint | methods | since | statuses |\n"
+            "|---|---|---|---|\n"
+            "| `/worker/x` | POST | 1– | 200 |\n"
+            "| `/worker/staged` | POST | 3– | 200 |\n\n"
+            "## Versioning\n\nCurrent wire version: **3**.\n"
+            f"Contract fingerprint: "
+            f"`{protocol.contract_fingerprint(tree)}`.\n")
+        assert not protocol.check_version_surface(tree, str(tmp_path))
+
     def test_detects_raw_transport_bypass(self, tmp_path):
         """A raw transport outside the nemesis+trace seams is the
         'same shared seams' invariant breaking."""

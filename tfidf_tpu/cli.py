@@ -437,25 +437,32 @@ def cmd_query(args) -> int:
         print("query needs --leader URL or --via-router URL",
               file=sys.stderr)
         return 2
-    body = json.dumps({"query": " ".join(args.query)}).encode()
-    if via:
-        # router path: surface the read plane's honesty headers —
-        # which placement world routed the request, and whether the
-        # results are degraded/stale (README "Scale-out query plane").
-        # Same polite-shed protocol as the --leader path: routers run
-        # their own admission controller, so a 429 here is expected.
-        hdrs, out = _shed_aware_post(
-            via.rstrip("/") + "/leader/start", body, who="router",
-            return_headers=True)
-        for h in ("X-Route-Epoch", "X-Route-Generation",
-                  "X-Scatter-Degraded"):
-            v = hdrs.get(h)
-            if v:
-                print(f"{h}: {v}", file=sys.stderr)
-        print(out.decode())
-        return 0
-    resp = _shed_aware_post(_leader_url(args) + "/leader/start", body)
-    print(resp.decode())
+    payload = {"query": " ".join(args.query)}
+    # hybrid plan (wire v3): mode/fusion are ADDITIVE fields — a plain
+    # sparse query sends neither, staying byte-identical to a v2
+    # request (README "Hybrid retrieval")
+    mode = getattr(args, "mode", None)
+    if mode and mode != "sparse":
+        payload["mode"] = mode
+        if getattr(args, "fusion", None):
+            payload["fusion"] = args.fusion
+    body = json.dumps(payload).encode()
+    target = (via.rstrip("/") if via else _leader_url(args))
+    # surface the read plane's honesty headers — which stages ran
+    # (X-Search-Stages carries the fusion method + weights), which
+    # placement world routed the request, and whether the results are
+    # degraded/stale. Same polite-shed protocol on both paths: leaders
+    # and routers each run an admission controller, so a 429 here is
+    # expected.
+    hdrs, out = _shed_aware_post(
+        target + "/leader/start", body,
+        who=("router" if via else "leader"), return_headers=True)
+    for h in ("X-Search-Stages", "X-Route-Epoch", "X-Route-Generation",
+              "X-Scatter-Degraded"):
+        v = hdrs.get(h)
+        if v:
+            print(f"{h}: {v}", file=sys.stderr)
+    print(out.decode())
     return 0
 
 
@@ -593,6 +600,11 @@ def cmd_status(args) -> int:
                                  for s in out["services"]] \
         + [("router", str(r)) for r in router_urls]
     versions = []
+    # embedding-column summary (README "Hybrid retrieval"): per-member
+    # dense-plane footprint from the same /api/health sweep — model,
+    # dims, docs embedded, bytes resident. A member with the dense
+    # plane off (or predating it) simply has no row.
+    columns = []
     for role, member in members:
         try:
             h = json.loads(http_get(
@@ -602,6 +614,14 @@ def cmd_status(args) -> int:
                              "proto_version":
                                  int(h.get("proto_version", 1)),
                              "reachable": True})
+            emb = h.get("embedding")
+            if emb:
+                columns.append({"url": member,
+                                "model": emb.get("model"),
+                                "dim": emb.get("dim"),
+                                "docs_embedded": int(emb.get("docs", 0)),
+                                "bytes_resident":
+                                    int(emb.get("bytes", 0))})
         except Exception:
             versions.append({"url": member, "role": role,
                              "proto_version": None,
@@ -612,6 +632,14 @@ def cmd_status(args) -> int:
         "members": versions,
         "proto_versions_seen": seen,
         "mixed_versions": len(seen) > 1,
+    }
+    out["embedding"] = {
+        "enabled": bool(columns),
+        "columns": columns,
+        "docs_embedded_total":
+            sum(c["docs_embedded"] for c in columns),
+        "bytes_resident_total":
+            sum(c["bytes_resident"] for c in columns),
     }
     out["admission"] = {
         "admitted_total": int(metrics.get("admission_admitted", 0)),
@@ -991,6 +1019,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="route the read through a stateless router "
                         "(prints the X-Route-Epoch/Generation stamp "
                         "and any degraded marker to stderr)")
+    s.add_argument("--mode", choices=["sparse", "dense", "hybrid"],
+                   default="sparse",
+                   help="retrieval plan: sparse TF-IDF (default), "
+                        "dense embedding cosine, or hybrid fused "
+                        "top-k (prints the stages ran + fusion "
+                        "weights to stderr via X-Search-Stages)")
+    s.add_argument("--fusion", choices=["rrf", "wsum"],
+                   help="hybrid fusion method (default: the cluster's "
+                        "fusion_method config)")
     s.set_defaults(fn=cmd_query)
 
     s = sub.add_parser("status", help="node role + membership + metrics")

@@ -347,7 +347,12 @@ class SearchNode(ScatterReadPlane):
             max_batch=self.config.scatter_batch,
             linger_s=self.config.scatter_linger_ms / 1e3,
             pipeline=self.config.scatter_pipeline, name="scatter",
-            group_key=lambda _q: self._cluster_epoch,
+            # items are (query, mode, fusion): one coalesced batch is
+            # one ownership world view AND one retrieval plan — sparse,
+            # dense and hybrid queries never share a scatter RPC
+            group_key=lambda q: (self._cluster_epoch, q[1], q[2])
+            if isinstance(q, tuple) else (self._cluster_epoch,
+                                          "sparse", None),
             bulk_share=self.config.scatter_bulk_share,
             **_linger_bounds(self.config.scatter_linger_min_ms,
                              self.config.scatter_linger_max_ms))
@@ -787,6 +792,51 @@ class SearchNode(ScatterReadPlane):
         global_metrics.observe("worker_batch_pack",
                                time.perf_counter() - t0)
         return body
+
+    def worker_search_staged_wire(self, queries: list[str],
+                                  k: int | None = None,
+                                  mode: str = "hybrid",
+                                  deadline: float | None = None) -> bytes:
+        """Two-stage scatter reply (mode dense|hybrid): ``2n`` hit
+        lists on the ordinary packed wire — the first ``n`` are the
+        sparse stage (empty lists for mode=dense, keeping the slot
+        layout uniform), the last ``n`` the dense stage. Dense lists
+        always ride ``pack_hit_lists``, never the arrays fast path:
+        ``pack_topk_arrays`` drops scores <= 0, and signed-hash cosines
+        are legitimately negative."""
+        if mode == "hybrid":
+            sparse = self.worker_search_batch(queries, k=k,
+                                              deadline=deadline)
+        else:
+            sparse = [[] for _ in queries]
+        dense = self._search_batch_guarded(
+            len(queries),
+            lambda: self.engine.search_dense_batch(queries, k=k),
+            deadline=deadline)
+        global_metrics.inc("worker_dense_batches")
+        return pack_hit_lists(list(sparse) + list(dense))
+
+    def worker_search_slice_staged(self, queries: list[str],
+                                   names: list[str], mode: str,
+                                   deadline: float | None = None
+                                   ) -> list[list[tuple[str, float]]]:
+        """Failover / hedge slice for a staged query: ``2n`` lists in
+        the same (sparse block, dense block) layout as the batched
+        reply, exact within the slice for BOTH stages — a failover
+        must re-issue every stage the dead owner would have run."""
+        if mode == "hybrid":
+            sparse = self.worker_search_slice(queries, names,
+                                              deadline=deadline)
+        else:
+            sparse = [[] for _ in queries]
+            global_metrics.inc("worker_slice_rpcs")
+        dmaps = self._search_batch_guarded(
+            len(queries),
+            lambda: self.engine.search_dense_names(queries, names),
+            deadline=deadline)
+        dense = [sorted(m.items(), key=lambda kv: (-kv[1], kv[0]))
+                 for m in dmaps]
+        return list(sparse) + dense
 
     def notify_write(self) -> None:
         """Mark uncommitted writes (called by the upload handler)."""
@@ -2497,7 +2547,11 @@ class _NodeHandler(_HttpHandlerBase):
                     "proto_version": PROTO_VERSION,
                     "scatter_queue_depth": global_metrics.get(
                         "last_scatter_queue_depth", 0.0),
-                    "admission": node.admission.snapshot()})
+                    "admission": node.admission.snapshot(),
+                    # embedding-column summary (dims, docs embedded,
+                    # bytes resident) for the CLI status fan-out; null
+                    # when the dense plane is disabled
+                    "embedding": node.engine.dense_stats()})
             elif u.path == "/worker/index-size":
                 self._text(str(node.engine.index_size_bytes()))
             elif u.path == "/worker/names":
@@ -2635,6 +2689,13 @@ class _NodeHandler(_HttpHandlerBase):
                 queries = [str(q) for q in req.get("queries", ())]
                 k = req.get("k")
                 names = req.get("names")
+                # hybrid plan (wire v3): "mode" selects which scoring
+                # stages run. Absent -> sparse, so v2 leaders are
+                # untouched; a v2 WORKER ignoring the field replies n
+                # lists where the leader expects 2n and the leader's
+                # slot-count check degrades honestly (never merges a
+                # misaligned reply).
+                mode = str(req.get("mode", "sparse"))
                 # continues the leader's scatter trace (propagated
                 # headers); the engine's trace_phase events and the
                 # pipeline stage events land inside this span — and so
@@ -2651,11 +2712,21 @@ class _NodeHandler(_HttpHandlerBase):
                         slice=len(names) if names is not None
                         else 0):
                     try:
-                        if names is not None:
+                        if names is not None and mode != "sparse":
+                            body = pack_hit_lists(
+                                node.worker_search_slice_staged(
+                                    queries, [str(n) for n in names],
+                                    mode, deadline=deadline))
+                        elif names is not None:
                             body = pack_hit_lists(
                                 node.worker_search_slice(
                                     queries, [str(n) for n in names],
                                     deadline=deadline))
+                        elif mode != "sparse":
+                            body = node.worker_search_staged_wire(
+                                queries,
+                                k=int(k) if k is not None else None,
+                                mode=mode, deadline=deadline)
                         else:
                             body = node.worker_search_batch_wire(
                                 queries,

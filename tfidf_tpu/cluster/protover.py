@@ -48,13 +48,23 @@ a way an old peer could misread; update the README fingerprint and the
   1  the implicit pre-versioning wire (PRs 1-15): no version header.
   2  this module: X-Proto-Version / X-Proto-Rejected, 426 rejections,
      capture/replay request log, /api/health proto_version field.
+  3  hybrid retrieval: additive ``mode`` (sparse|dense|hybrid) and
+     ``fusion`` (rrf|wsum) fields on /leader/start and ``mode`` on
+     /worker/process-batch (all slice re-issues too); staged replies
+     carry 2n hit lists (n sparse then n dense) on the v2 packed
+     wire; /leader/start replies stamp X-Search-Stages; /api/health
+     gains the ``embedding`` block. Absent fields mean sparse — a
+     v2 request is byte-for-byte a valid v3 sparse request, and a
+     v2 worker that ignores ``mode`` replies n lists, which the
+     leader's slot-count check catches (honest degradation, never a
+     silently sparse-only "hybrid" result).
 """
 
 from __future__ import annotations
 
 # the current wire-protocol version this binary speaks (see history
 # table above — bump beside any wire-surface change)
-PROTO_VERSION = 2
+PROTO_VERSION = 3
 
 # the wire contract (stamped/checked at the shared HTTP seams)
 PROTO_HEADER = "X-Proto-Version"

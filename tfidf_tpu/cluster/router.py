@@ -61,6 +61,7 @@ from tfidf_tpu.cluster.autopilot import Autopilot
 from tfidf_tpu.cluster.batcher import Coalescer
 from tfidf_tpu.cluster.coordination import (EPHEMERAL_SEQUENTIAL,
                                             NoNodeError)
+from tfidf_tpu.cluster.fusion import FUSION_METHODS, fuse
 from tfidf_tpu.cluster.placement import PlacementFollower, PlacementMap
 from tfidf_tpu.cluster.protover import (PROTO_HEADER,
                                         PROTO_REJECTED_HEADER,
@@ -191,7 +192,9 @@ class ScatterReadPlane:
     _PER_QUERY_BUDGET_S = 10.0
 
     def leader_search_with_health(self, query: str,
-                                  lane: str = LANE_INTERACTIVE
+                                  lane: str = LANE_INTERACTIVE,
+                                  mode: str = "sparse",
+                                  fusion: str | None = None
                                   ) -> tuple[dict[str, float], dict]:
         """``leader_search`` plus this request's OWN health marker —
         ``(merged, {attempted, responded, circuit_open, degraded,
@@ -209,9 +212,15 @@ class ScatterReadPlane:
         BEFORE dispatch, so a commit (or view refresh) that lands
         mid-scatter invalidates the entry this request inserts."""
         token = self.df_signature()
+        # hybrid plan (wire v3): mode/fusion compose into the cache key
+        # (a hybrid result must never answer a sparse query or vice
+        # versa) and ride the coalescer item so batches stay homogeneous
+        # per (mode, fusion) via the group key.
+        qkey = (query if mode == "sparse"
+                else f"\x00{mode}\x00{fusion or ''}\x00{query}")
         cache = self.result_cache if not self._view_suspect() else None
         if cache is not None:
-            hit = cache.get(query, token)
+            hit = cache.get(qkey, token)
             if hit is not None:
                 # a cache hit did no fan-out: its health marker says so
                 # (and is never recorded into the shared gauges — it
@@ -227,9 +236,19 @@ class ScatterReadPlane:
                              "route_epoch": epoch, "route_gen": gen}
         if self.scatter_batcher is not None:
             result, health = self.scatter_batcher.submit(
-                query, lane=1 if lane == LANE_BULK else 0)
+                (query, mode, fusion), lane=1 if lane == LANE_BULK else 0)
             if cache is not None and not health.get("degraded"):
-                cache.put(query, token, result)
+                cache.put(qkey, token, result)
+            return result, health
+        if mode != "sparse":
+            # no coalescer (unbounded-results / micro-batch-off
+            # configs): staged queries still go through the batched
+            # scatter — the per-query JSON path below is sparse-only —
+            # as a one-item batch
+            result, health = self._scatter_search_batch(
+                [(query, mode, fusion)])[0]
+            if cache is not None and not health.get("degraded"):
+                cache.put(qkey, token, result)
             return result, health
         log.info("scatter search", query=query)
         body = json.dumps({"query": query}).encode()
@@ -322,9 +341,26 @@ class ScatterReadPlane:
         path (the reference pays it by design, one RestTemplate POST
         per worker per query, ``Leader.java:51-70``). A failed worker's
         ownership slice fails over to surviving replicas WITHIN this
-        request."""
-        body = json.dumps({"queries": queries,
-                           "k": self.config.top_k}).encode()
+        request.
+
+        Items are plain query strings (sparse) or ``(query, mode,
+        fusion)`` tuples — the coalescer's group key keeps a batch
+        homogeneous in (mode, fusion), so one batch runs ONE plan.
+        Staged plans (mode dense|hybrid, wire v3) ask each worker for
+        ``2n`` hit lists (n sparse + n dense), owner-merge each stage
+        independently (per-stage global top-k is exact — one owner per
+        doc), and fuse the two merged maps per query
+        (:mod:`tfidf_tpu.cluster.fusion`)."""
+        items = [(q, "sparse", None) if isinstance(q, str) else q
+                 for q in queries]
+        queries = [q for q, _m, _f in items]
+        mode = items[0][1]
+        fusion = items[0][2] or self.config.fusion_method
+        staged = mode != "sparse"
+        payload = {"queries": queries, "k": self.config.top_k}
+        if staged:
+            payload["mode"] = mode
+        body = json.dumps(payload).encode()
         t_deadline = time.monotonic() + self.config.scatter_timeout_s
 
         def rpc_one(addr: str, live: set[str],
@@ -349,8 +385,30 @@ class ScatterReadPlane:
                                    time.perf_counter() - t1)
             return hit_lists
 
-        merged, health = self._gather_merge(queries, rpc_one, t_deadline)
+        merged, health = self._gather_merge(
+            queries, rpc_one, t_deadline,
+            slots=len(queries) * 2 if staged else None,
+            slice_extra={"mode": mode} if staged else None)
         t0 = time.perf_counter()
+        if staged:
+            # fuse AFTER the per-stage global owner-merge: each stage's
+            # merged map contains the union of per-worker top-ks, so
+            # its rank_list is the exact global stage top-k — fusing
+            # two exact lists matches the single-node oracle.
+            n = len(queries)
+            c = self.config
+            fused: list[dict[str, float]] = []
+            for i in range(n):
+                if mode == "dense":
+                    fused.append(merged[n + i])
+                else:
+                    fused.append(fuse(
+                        merged[i], merged[n + i], method=fusion,
+                        k=c.top_k, rrf_k=c.fusion_rrf_k,
+                        w_sparse=c.fusion_weight_sparse,
+                        w_dense=c.fusion_weight_dense))
+            merged = fused
+            global_metrics.inc("hybrid_scatter_batches")
         # one (result, health) pair per coalesced query: every caller in
         # the group shares this batch's fan-out, so each reply carries
         # this batch's marker
@@ -361,7 +419,8 @@ class ScatterReadPlane:
     def _slice_call(self, addr: str, queries: list[str],
                     names: list[str], t_deadline: float,
                     live: set[str], trace_parent=None,
-                    kind: str = "failover"
+                    kind: str = "failover",
+                    extra: dict | None = None
                     ) -> list[list[tuple[str, float]]]:
         """Failover / hedged read: score the ``names`` ownership slice
         on a surviving replica (one breaker-gated, retried logical
@@ -372,14 +431,18 @@ class ScatterReadPlane:
         ``trace_parent`` parents the slice span under the scatter span
         that dispatched it (the slice pool thread has no ambient
         context); ``kind`` distinguishes a failover re-issue from a
-        hedged duplicate in the trace."""
+        hedged duplicate in the trace. ``extra`` carries additional
+        request fields — the staged plan's ``mode``, so a failover
+        slice re-issues BOTH scoring stages the dead owner would have
+        run."""
         def rpc() -> list[list[tuple[str, float]]]:
             global_injector.check("leader.replica_rpc")
             remaining = t_deadline - time.monotonic()
             if remaining <= 0:
                 raise DeadlineExpired(addr + ": budget spent")
             body = json.dumps({"queries": queries,
-                               "names": names}).encode()
+                               "names": names,
+                               **(extra or {})}).encode()
             raw = self._scatter.post(
                 addr, "/worker/process-batch", body,
                 timeout=remaining, live=live,
@@ -399,7 +462,8 @@ class ScatterReadPlane:
             return run()
 
     def _gather_merge(self, queries: list[str], rpc_one,
-                      t_deadline: float
+                      t_deadline: float, slots: int | None = None,
+                      slice_extra: dict | None = None
                       ) -> tuple[list[dict[str, float]], dict]:
         """The scatter/merge/failover spine shared by the per-query and
         batched paths — and by every read-plane host (leader, any-node
@@ -425,7 +489,18 @@ class ScatterReadPlane:
            Hedge results are deduped by owner epoch: if the primary
            answered after all, its epoch-0 hits win and the hedge is
            discarded.
+
+        ``slots`` is the hit-list count each worker reply must carry
+        (default ``len(queries)``; the staged hybrid plan sends
+        ``2 * len(queries)`` — n sparse + n dense — and each slot is
+        owner-merged independently). ``slice_extra`` rides every
+        failover/hedge slice request body, so a staged plan's
+        re-issued slices run the same stages the dead owner would
+        have (a v2 worker ignoring it replies ``len(queries)`` lists
+        and fails the slot check — honest degradation, never a
+        misaligned merge).
         """
+        slots = slots if slots is not None else len(queries)
         workers = self.registry.get_all_service_addresses()
         live = set(workers)
         self.resilience.prune(live)   # breakers + latency EWMAs
@@ -486,7 +561,8 @@ class ScatterReadPlane:
                     hedge_futs.setdefault(addr, []).append(
                         (backup, ns, self._slice_pool.submit(
                             self._slice_call, backup, queries, ns,
-                            t_deadline, live, tparent, "hedge")))
+                            t_deadline, live, tparent, "hedge",
+                            slice_extra)))
             hedge_laggards(dict(futures), hedge_ms / 1e3,
                            dispatch_hedge)
 
@@ -583,7 +659,7 @@ class ScatterReadPlane:
                 log.warning("worker failed during search", worker=addr,
                             err=repr(e))
                 continue
-            if len(hit_lists) != len(queries):
+            if len(hit_lists) != slots:
                 failed.add(addr)
                 global_metrics.inc("scatter_failures")
                 log.warning("batch reply length mismatch", worker=addr)
@@ -602,7 +678,7 @@ class ScatterReadPlane:
         # exact failure the view split exists to prevent
         sum_unmapped = not isinstance(pmap, PlacementFollower)
         dropped = 0
-        merged: list[dict[str, float]] = [{} for _ in queries]
+        merged: list[dict[str, float]] = [{} for _ in range(slots)]
         for addr, hit_lists in ok.items():
             skip = excluded.get(addr)
             for m, hits in zip(merged, hit_lists):
@@ -657,7 +733,7 @@ class ScatterReadPlane:
                     log.warning("failover slice failed", worker=backup,
                                 names=len(ns), err=repr(e))
                     return
-                if len(hit_lists) != len(queries):
+                if len(hit_lists) != slots:
                     failed_backups.add(backup)
                     global_metrics.inc("scatter_failover_failures")
                     return
@@ -688,7 +764,8 @@ class ScatterReadPlane:
                 fresh_pending = [
                     (backup, ns, self._slice_pool.submit(
                         self._slice_call, backup, queries, ns,
-                        t_deadline, live, tparent, "failover"))
+                        t_deadline, live, tparent, "failover",
+                        slice_extra))
                     for backup, ns in pmap.backups_for(
                         fresh, exclude=failed | failed_backups,
                         live=live, avoid=open_set).items()]
@@ -1073,6 +1150,31 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
                 pass
         return body
 
+    def _read_search_request(self) -> tuple[str, str, str | None]:
+        """Query plus retrieval plan for ``/leader/start``: JSON bodies
+        may carry ``mode`` (``sparse`` | ``dense`` | ``hybrid``) and
+        ``fusion`` (``rrf`` | ``wsum``) beside ``query``. Raw-text
+        bodies and absent fields mean ``mode=sparse`` — the field is
+        additive, so a v2 client's request is exactly a sparse request
+        (cluster/protover.py history, wire v3). Values are returned
+        unvalidated; ``_serve_search`` rejects unknown ones with 400."""
+        body = self._body().decode("utf-8", "replace")
+        if body[:1].isspace():
+            body = body.lstrip()
+        if body[:1] in ('{', '"'):
+            try:
+                obj = json.loads(body)
+                if isinstance(obj, dict) and "query" in obj:
+                    fusion = obj.get("fusion")
+                    return (str(obj["query"]),
+                            str(obj.get("mode") or "sparse"),
+                            str(fusion) if fusion is not None else None)
+                if isinstance(obj, str):
+                    return obj, "sparse", None
+            except json.JSONDecodeError:
+                pass
+        return body, "sparse", None
+
     # ---- shared read-plane routes ----
 
     def _serve_search(self) -> None:
@@ -1088,24 +1190,53 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
                             LANE_INTERACTIVE) as (sp, lane):
             if sp is None:
                 return
-            query = self._read_query()
+            query, mode, fusion = self._read_search_request()
+            if mode not in ("sparse", "dense", "hybrid"):
+                self._json({"error": "unknown mode",
+                            "mode": mode,
+                            "allowed": ["sparse", "dense", "hybrid"]},
+                           code=400)
+                return
+            if fusion is not None and fusion not in FUSION_METHODS:
+                self._json({"error": "unknown fusion method",
+                            "fusion": fusion,
+                            "allowed": list(FUSION_METHODS)},
+                           code=400)
+                return
+            if mode != "sparse" and not node.config.embedding_enabled:
+                self._json({"error": "dense plane disabled "
+                                     "(embedding_enabled=False)",
+                            "mode": mode}, code=400)
+                return
             # traffic-capture tap: every ADMITTED search lands in the
             # durable request log (query + arrival offset + lane +
             # client) when capture is armed — shed requests are
             # deliberately not captured, so a replay reproduces the
             # admitted workload, not the overload that was refused
+            # (the log records the bare query; replays run sparse)
             rlog = getattr(node, "request_log", None)
             if rlog is not None:
                 rlog.record(query, lane,
                             self.headers.get("X-Client-Id")
                             or self.client_address[0])
             result, health = node.leader_search_with_health(
-                query, lane=lane)
+                query, lane=lane, mode=mode, fusion=fusion)
             # degraded marker: the body stays reference-compatible
             # (name -> score); the headers say whether every live
             # worker's shard is represented, which placement world
             # routed the request, and which trace reconstructs it
             hdrs = {TRACE_HEADER: sp.trace_id}
+            # staged-plan stamp (wire v3): derived from the REQUEST, not
+            # from health, so cache hits stamp identically and the pinned
+            # cache-hit health dict stays untouched
+            if mode == "dense":
+                hdrs["X-Search-Stages"] = "dense"
+            elif mode == "hybrid":
+                fs = fusion or node.config.fusion_method
+                hdrs["X-Search-Stages"] = (
+                    "sparse,dense; fusion={} w={:g}/{:g}".format(
+                        fs, node.config.fusion_weight_sparse,
+                        node.config.fusion_weight_dense))
             if health.get("route_epoch") is not None:
                 hdrs["X-Route-Epoch"] = str(health["route_epoch"])
             if health.get("route_gen") is not None:
@@ -1469,8 +1600,13 @@ class QueryRouter(ScatterReadPlane):
             linger_s=self.config.scatter_linger_ms / 1e3,
             pipeline=self.config.scatter_pipeline,
             name="router_scatter",
-            group_key=lambda _q: (self._cluster_epoch,
-                                  self.placement.version),
+            # (epoch, view, mode, fusion): batches stay homogeneous in
+            # world view AND retrieval plan (items are (q, mode, fusion))
+            group_key=lambda q: (self._cluster_epoch,
+                                 self.placement.version, q[1], q[2])
+            if isinstance(q, tuple) else (self._cluster_epoch,
+                                          self.placement.version,
+                                          "sparse", None),
             bulk_share=self.config.scatter_bulk_share,
             **_linger_bounds(self.config.scatter_linger_min_ms,
                              self.config.scatter_linger_max_ms))

@@ -105,6 +105,21 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
                   lengths=lengths)
     storage.write_bytes(os.path.join(build, "names.json"),
                         json.dumps([d.name for d in entries]).encode())
+    # dense plane (ISSUE 17): the embedding column rides the same build
+    # dir, so the manifest + publish_dir discipline covers it for free
+    # (a torn embeddings.npz is caught by the same CRC pass as a torn
+    # docs.npz). Rows are stored with an index into names.json instead
+    # of duplicating the name strings.
+    emb_meta = None
+    if engine.dense is not None:
+        rows, dnames = engine.dense.export_arrays()
+        pos = {name: i for i, name in enumerate(d.name for d in entries)}
+        if all(nm in pos for nm in dnames):
+            storage.savez(
+                os.path.join(build, "embeddings.npz"), rows=rows,
+                name_idx=np.fromiter((pos[nm] for nm in dnames),
+                                     np.int64, len(dnames)))
+            emb_meta = engine.dense.embedder.signature()
     # fast-restore payload: the committed snapshot's device arrays, so
     # load skips the O(corpus) host COO/ELL re-layout (VERDICT r3 #5).
     # The snapshot's doc order is its own (width-sorted); store it as a
@@ -149,6 +164,7 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
         "nnz": nnz,
         "vocab_size": len(engine.vocab),
         "snapshot": snap_meta,
+        "embedding": emb_meta,
         # wall-clock save time: serve's boot re-walk only re-ingests
         # files modified after this (minus slack), keeping the
         # reference's rebuild-from-documents property without paying
@@ -181,6 +197,41 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
         shutil.rmtree(f"{base}.v{v}", ignore_errors=True)
     log.info("checkpoint saved", dir=directory, docs=n, nnz=nnz,
              version=version)
+
+
+def _restore_dense(engine: Engine, directory: str, meta: dict,
+                   names: list, offsets, term_ids, tfs) -> None:
+    """Repopulate the embedding column. Fast path: install the stored
+    rows when the checkpoint's embedding signature (model, dim) matches
+    the running config. Fallback (legacy checkpoint, signature change):
+    re-embed every document from the checkpoint's own term table —
+    ``vocab.txt`` line ``i`` IS term id ``i``, so ``term_ids``/``tfs``
+    reconstruct exactly the analyzer's token->tf counts the embedder
+    consumed at ingest. Either way the column is rebuilt, never
+    silently stale."""
+    if engine.dense is None:
+        return
+    emb_path = os.path.join(directory, "embeddings.npz")
+    want = engine.dense.embedder.signature()
+    if meta.get("embedding") == want and os.path.exists(emb_path):
+        data = np.load(emb_path)
+        engine.dense.install_arrays(
+            data["rows"], [names[i] for i in data["name_idx"]])
+        engine.dense.commit()
+        return
+    global_metrics.inc("checkpoint_dense_reembeds")
+    with open(os.path.join(directory, "vocab.txt"),
+              encoding="utf-8") as f:
+        terms = f.read().splitlines()
+    lo_list = offsets[:-1].tolist()
+    hi_list = offsets[1:].tolist()
+    for i, name in enumerate(names):
+        ids = term_ids[lo_list[i]:hi_list[i]]
+        weights = tfs[lo_list[i]:hi_list[i]]
+        engine.dense.upsert(
+            name, {terms[int(t)]: float(w)
+                   for t, w in zip(ids, weights)})
+    engine.dense.commit()
 
 
 def load_checkpoint(directory: str, config: Config | None = None,
@@ -229,6 +280,8 @@ def load_checkpoint(directory: str, config: Config | None = None,
                                                tfs, lengths)
         engine.index.install_full_state(np.load(seg_path), entries)
         engine.commit()
+        _restore_dense(engine, directory, meta, names, offsets,
+                       term_ids, tfs)
         log.info("checkpoint loaded", dir=directory, docs=len(names),
                  fast_snapshot="segments")
         return engine
@@ -268,6 +321,8 @@ def load_checkpoint(directory: str, config: Config | None = None,
             installed = True
     if not installed:
         engine.commit()
+    _restore_dense(engine, directory, meta, names, offsets, term_ids,
+                   tfs)
     log.info("checkpoint loaded", dir=directory, docs=len(names),
              fast_snapshot=installed)
     return engine

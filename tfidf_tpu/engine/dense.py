@@ -1,0 +1,188 @@
+"""Per-document embedding column — the dense plane's ``ShardIndex``.
+
+Host side: a name -> L2-normalized f32 vector map, mutated under the
+engine's write lock by the same upsert/delete calls that feed the
+sparse postings.  Device side: a committed snapshot — rows compacted in
+**sorted-name order** (deterministic, so ``lax.top_k``'s lower-index
+tie-break IS the leader's ``(-score, name)`` tie-break and replicas
+are bit-identical), doc capacity padded to a power-of-two bucket and
+``dim`` padded to a multiple of 128 so every executable of
+``ops/dense.py`` is MXU-shaped and jit-cached per capacity.
+
+The column rides the PR 13 storage seam: ``export_arrays`` /
+``install_arrays`` are the checkpoint format (an ``embeddings.npz``
+member in the ``.v<N>`` build dir, manifest-covered like every other
+member), and a checkpoint whose embedding signature (model, dim)
+doesn't match the running config is re-embedded from source text
+rather than silently served.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence
+
+import numpy as np
+
+from ..ops.csr import next_capacity
+from ..ops.dense import packed_dense_topk
+from ..ops.topk import unpack_topk
+from .embedder import Embedder
+
+_LANE = 128      # MXU lane width: dim is padded up to a multiple
+
+
+def _pad_dim(dim: int) -> int:
+    return max(_LANE, -(-dim // _LANE) * _LANE)
+
+
+class EmbeddingColumn:
+    """Not thread-safe by itself — the engine serializes mutations under
+    its write lock, exactly like the sparse index."""
+
+    def __init__(self, embedder: Embedder, *, min_doc_capacity: int = 64,
+                 chunk: int = 1 << 14):
+        self.embedder = embedder
+        self.dim = embedder.dim
+        self._chunk = int(chunk)
+        self._min_cap = int(min_doc_capacity)
+        self._vecs: Dict[str, np.ndarray] = {}     # host truth
+        # committed device snapshot
+        self._names: List[str] = []                # sorted, row i <-> name
+        self._slot: Dict[str, int] = {}            # committed name -> row
+        self._emb_dev = None                       # f32 [doc_cap, dim_pad]
+        self._num_docs_dev = None                  # i32 scalar on device
+        self._doc_cap = 0
+        self._dirty = False
+
+    # -- mutation (engine write lock held) --------------------------------
+
+    def upsert(self, name: str, counts: Mapping[str, float]) -> None:
+        self._vecs[name] = self.embedder.embed_counts(counts)
+        self._dirty = True
+
+    def delete(self, name: str) -> bool:
+        if self._vecs.pop(name, None) is None:
+            return False
+        self._dirty = True
+        return True
+
+    def commit(self) -> None:
+        """Compact live rows (sorted by name) into a fresh device
+        snapshot. O(docs) host work per commit — same order as the
+        sparse snapshot rebuild it rides along with."""
+        if not self._dirty and self._emb_dev is not None:
+            return
+        import jax.numpy as jnp
+
+        self._names = sorted(self._vecs)
+        self._slot = {n: i for i, n in enumerate(self._names)}
+        n = len(self._names)
+        cap = next_capacity(max(n, 1), self._min_cap)
+        dim_pad = _pad_dim(self.dim)
+        host = np.zeros((cap, dim_pad), dtype=np.float32)
+        for i, name in enumerate(self._names):
+            host[i, :self.dim] = self._vecs[name]
+        self._emb_dev = jnp.asarray(host)
+        self._num_docs_dev = jnp.asarray(np.int32(n))
+        self._doc_cap = cap
+        self._dirty = False
+
+    # -- search (committed snapshot) --------------------------------------
+
+    def _embed_queries(self, queries_counts: Sequence[Mapping[str, float]]
+                       ) -> np.ndarray:
+        dim_pad = _pad_dim(self.dim)
+        q = np.zeros((len(queries_counts), dim_pad), dtype=np.float32)
+        for i, counts in enumerate(queries_counts):
+            q[i, :self.dim] = self.embedder.embed_query(counts)
+        return q
+
+    def search_batch(self, queries_counts: Sequence[Mapping[str, float]],
+                     k: int) -> List[List[tuple]]:
+        """Exact dense top-k per query: ``[(name, score), ...]`` sorted
+        by (-score, name). Empty column -> empty lists (never NaN)."""
+        if self._dirty or self._emb_dev is None:
+            self.commit()
+        n_live = len(self._names)
+        if not queries_counts:
+            return []
+        if n_live == 0:
+            return [[] for _ in queries_counts]
+        import jax.numpy as jnp
+
+        q_host = self._embed_queries(queries_counts)
+        # pad the batch to a power-of-two bucket so executables are
+        # reused across nearby batch sizes (same policy as the sparse
+        # scoring path)
+        b_cap = next_capacity(len(queries_counts), 8)
+        if b_cap != q_host.shape[0]:
+            q_host = np.vstack(
+                [q_host, np.zeros((b_cap - q_host.shape[0],
+                                   q_host.shape[1]), dtype=np.float32)])
+        kk = min(int(k), self._doc_cap)
+        packed = packed_dense_topk(jnp.asarray(q_host), self._emb_dev,
+                                   self._num_docs_dev, k=kk,
+                                   chunk=self._chunk)
+        vals, ids = unpack_topk(packed)
+        out: List[List[tuple]] = []
+        for row in range(len(queries_counts)):
+            hits = []
+            for v, i in zip(vals[row], ids[row]):
+                if not np.isfinite(v):
+                    break            # ran out of live docs
+                hits.append((self._names[int(i)], float(v)))
+            out.append(hits)
+        return out
+
+    def search_names(self, queries_counts: Sequence[Mapping[str, float]],
+                     names: Sequence[str]) -> List[Dict[str, float]]:
+        """Failover-slice path: exact scores for a specific name set
+        (names this column doesn't hold are simply absent). Host-side
+        per-pair dots — a (query, doc) cosine depends only on the two
+        vectors, so replicas agree regardless of what else they hold."""
+        if self._dirty or self._emb_dev is None:
+            self.commit()
+        wanted = [n for n in names if n in self._slot]
+        out: List[Dict[str, float]] = []
+        if not wanted:
+            return [{} for _ in queries_counts]
+        rows = np.stack([np.asarray(
+            self._vecs[n], dtype=np.float32) for n in wanted])
+        for counts in queries_counts:
+            q = self.embedder.embed_query(counts).astype(np.float32)
+            scores = rows @ q
+            out.append({n: float(s) for n, s in zip(wanted, scores)})
+        return out
+
+    # -- checkpoint seam ---------------------------------------------------
+
+    def export_arrays(self) -> tuple:
+        """(rows f32 [n, dim], names) — live host vectors in sorted-name
+        order; the ``embeddings.npz`` checkpoint payload."""
+        names = sorted(self._vecs)
+        if names:
+            rows = np.stack([self._vecs[n] for n in names]).astype(
+                np.float32)
+        else:
+            rows = np.zeros((0, self.dim), dtype=np.float32)
+        return rows, names
+
+    def install_arrays(self, rows: np.ndarray,
+                       names: Sequence[str]) -> None:
+        if rows.shape[0] != len(names) or (
+                len(names) and rows.shape[1] != self.dim):
+            raise ValueError(
+                f"embedding column shape {rows.shape} does not match "
+                f"{len(names)} names x dim {self.dim}")
+        self._vecs = {str(n): np.asarray(rows[i], dtype=np.float32)
+                      for i, n in enumerate(names)}
+        self._dirty = True
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        host = len(self._vecs) * self.dim * 4
+        dev = (int(self._emb_dev.size) * 4
+               if self._emb_dev is not None else 0)
+        return {"model": self.embedder.name, "dim": self.dim,
+                "docs": len(self._vecs), "bytes": host + dev}

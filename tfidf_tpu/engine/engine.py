@@ -47,6 +47,7 @@ class Engine:
         # ``synchronized(indexWriter)``, Worker.java:136-139); RLock
         # because ingest_bytes -> ingest_text nests
         self._write_lock = threading.RLock()
+        self.dense = None    # set below; stays None for mesh layouts
         self.analyzer = Analyzer(
             lowercase=c.lowercase,
             stopwords=frozenset(c.stopwords),
@@ -147,6 +148,18 @@ class Engine:
             kernel_a_build=c.kernel_a_build,
             pipeline_depth=c.search_pipeline_depth,
             pipeline_mode=c.search_pipeline_mode)
+        # dense plane (ISSUE 17): a per-doc embedding column beside the
+        # sparse postings, mutated by the same ingest/delete calls under
+        # the same write lock and committed by the same commit(). Local
+        # engine mode only — the mesh layouts return above and get the
+        # standalone parallel/mesh_dense.py op instead.
+        if c.embedding_enabled:
+            from tfidf_tpu.engine.dense import EmbeddingColumn
+            from tfidf_tpu.engine.embedder import get_embedder
+            self.dense = EmbeddingColumn(
+                get_embedder(c.embedding_model, c.embedding_dim),
+                min_doc_capacity=c.min_doc_capacity,
+                chunk=c.embedding_chunk)
 
     # ---- ingest (Worker.upload / addDocToIndex analog) ----
 
@@ -156,7 +169,7 @@ class Engine:
         # this path, and neither Vocabulary.add (read-len-then-append)
         # nor the index mutation below is safe under interleaving
         with self._write_lock, trace_phase("analyze"):
-            if self.native is not None:
+            if self.native is not None and self.dense is None:
                 res = self.native.analyze(text, add=True)
                 if res is not None:
                     # observable fast-path hit rate: the native tokenizer
@@ -166,11 +179,18 @@ class Engine:
                     ids, tfs, length = res
                     self.index.add_document_arrays(name, ids, tfs, length)
                     return
+            # The embedding column needs token STRINGS (the embedder
+            # hashes them — vocab ids are per-worker insertion order and
+            # would break replica-identical dense scores), so with the
+            # dense plane on, every document takes the Python analyzer
+            # path and the counts feed both planes from ONE tokenize.
             global_metrics.inc("ingest_python_fallback")
             counts = self.analyzer.counts(text)
             length = float(sum(counts.values()))
             id_counts = self.vocab.map_counts(counts, add=True)
             self.index.add_document(name, id_counts, length=length)
+            if self.dense is not None:
+                self.dense.upsert(name, counts)
 
     def ingest_bytes(self, name: str, data: bytes,
                      save_to_disk: bool = False) -> None:
@@ -265,7 +285,10 @@ class Engine:
 
     def delete(self, name: str) -> bool:
         with self._write_lock:
-            return self.index.delete_document(name)
+            ok = self.index.delete_document(name)
+            if self.dense is not None:
+                self.dense.delete(name)
+            return ok
 
     def document_names(self) -> list[str] | None:
         """Names of all live indexed documents, or None when the index
@@ -281,6 +304,8 @@ class Engine:
         restarted worker's boot re-walk resurrects the moved doc."""
         with self._write_lock:
             ok = self.index.delete_document(rel)
+            if self.dense is not None:
+                self.dense.delete(rel)
             try:
                 path = self._safe_doc_path(rel)
                 if os.path.isfile(path):
@@ -292,6 +317,8 @@ class Engine:
     def commit(self) -> None:
         with self._write_lock, trace_phase("commit"), Stopwatch() as sw:
             self.index.commit(self.vocab.capacity())
+            if self.dense is not None:
+                self.dense.commit()
         log.info("commit", ms=sw.ms, docs=self.index.num_live_docs)
 
     def build_from_directory(self, docs_path: str | None = None,
@@ -355,6 +382,35 @@ class Engine:
         if arrays is None:
             return None
         return arrays(queries, k=k)
+
+    # ---- dense plane (ISSUE 17) ----
+
+    def search_dense_batch(self, queries: list[str],
+                           k: int | None = None) -> list[list[tuple]]:
+        """Exact dense top-k per query as ``[(name, score), ...]``
+        (cosine, sorted by (-score, name)). Loud when the dense plane
+        is off — a silent sparse fallback would fake hybrid results."""
+        if self.dense is None:
+            raise RuntimeError(
+                "dense plane disabled (embedding_enabled=False)")
+        kk = int(k) if k is not None else self.config.top_k
+        counts = [self.analyzer.counts(q) for q in queries]
+        return self.dense.search_batch(counts, kk)
+
+    def search_dense_names(self, queries: list[str],
+                           names: list[str]) -> list[dict]:
+        """Failover-slice dense scores: name->score per query for the
+        names this engine holds (absent names are simply missing)."""
+        if self.dense is None:
+            raise RuntimeError(
+                "dense plane disabled (embedding_enabled=False)")
+        counts = [self.analyzer.counts(q) for q in queries]
+        return self.dense.search_names(counts, names)
+
+    def dense_stats(self) -> dict | None:
+        """Embedding-column summary for /api/health and `status` — None
+        when the dense plane is off."""
+        return self.dense.stats() if self.dense is not None else None
 
     # ---- files (Worker.workerDownload analog) ----
 

@@ -1,0 +1,98 @@
+"""Blocked brute-force dense top-k — the MXU sibling of ``ops/ell.py``.
+
+The sparse kernels stream postings through the VPU; this plane scores a
+query batch against the whole embedding column with one matmul per doc
+chunk, which XLA lowers onto the MXU's 128x128 systolic tiles.  The
+column store (``engine/dense.py``) pads ``dim`` to a multiple of 128
+and ``doc_cap`` to a power-of-two bucket, so every executable here is
+MXU-shaped and jit-cached per (capacity, k, chunk) — the same
+compile-reuse discipline as the ELL kernels.
+
+Exactness contract: brute force, no ANN.  ``packed_dense_topk`` must
+match a numpy ``argsort(q @ E.T)`` oracle bit-for-bit on the winner
+set (ties break toward the lower doc id, ``lax.top_k`` semantics) —
+tests/test_hybrid.py gates every shape edge (dim not % 128, one live
+doc, zero live docs) on that oracle.
+
+Padding is masked, never trusted to be zero: padded doc rows score
+``-inf`` before ``top_k`` (a zero row would outrank genuinely negative
+cosines), and the chunk scan clamps its tail slice exactly like
+``ops/topk.packed_topk_chunked`` so no row can win twice.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .topk import merge_topk, pack_topk
+
+
+@jax.jit
+def dense_scores(queries: jax.Array,     # f32 [B, dim]
+                 emb: jax.Array,         # f32 [doc_cap, dim]
+                 num_docs: jax.Array,    # i32 scalar — live rows
+                 ) -> jax.Array:
+    """Full [B, doc_cap] cosine score matrix (rows are L2-normalized at
+    embed time, so the dot IS the cosine). Padded docs score -inf.
+    Small-corpus / oracle path — the serving path is the chunked top-k
+    below, which never materializes [B, doc_cap] temporaries beyond the
+    scores themselves."""
+    scores = jax.lax.dot_general(
+        queries, emb,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+    doc_cap = emb.shape[0]
+    live = jnp.arange(doc_cap, dtype=jnp.int32)[None, :] < num_docs
+    return jnp.where(live, scores, -jnp.inf)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def packed_dense_topk(queries: jax.Array,    # f32 [B, dim]
+                      emb: jax.Array,        # f32 [doc_cap, dim]
+                      num_docs: jax.Array,   # i32 scalar
+                      *, k: int, chunk: int = 1 << 14) -> jax.Array:
+    """Exact dense top-k, packed for the wire (``ops/topk.pack_topk``
+    layout: f32 score bits bitcast into i32 lanes beside the ids).
+
+    The doc axis is scanned in ``chunk``-row blocks: each block is one
+    [B, dim] x [chunk, dim]^T matmul (MXU work) followed by a masked
+    ``lax.top_k`` (VPU work), and per-chunk winners merge exactly.
+    Temporaries are O(B * chunk) instead of O(B * doc_cap) — at 1M docs
+    and dim 128 the full score matrix alone would be 4 GB at B=1024.
+    """
+    doc_cap = emb.shape[0]
+    # a chunk must hold at least k rows (lax.top_k's axis bound); the
+    # caller already clamps k <= doc_cap
+    c = min(max(chunk, k), doc_cap)
+    n = -(-doc_cap // c)     # ceil: the tail chunk is clamped, not ragged
+
+    if n == 1:
+        scores = dense_scores(queries, emb, num_docs)
+        vals, idx = jax.lax.top_k(scores, k)
+        return pack_topk(vals, idx.astype(jnp.int32))
+
+    def body(_, off):
+        # Clamp the last chunk's start to doc_cap - c so every slice is
+        # full-width; rows the clamp re-reads (idx < off) are masked out
+        # so no doc can win twice in the merge.
+        start = jnp.minimum(off, doc_cap - c)
+        rows = jax.lax.dynamic_slice_in_dim(emb, start, c, axis=0)
+        part = jax.lax.dot_general(
+            queries, rows,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        idx = jnp.arange(c, dtype=jnp.int32)[None, :] + start
+        masked = jnp.where((idx >= off) & (idx < num_docs), part,
+                           -jnp.inf)
+        v, i = jax.lax.top_k(masked, k)
+        return None, (v, i.astype(jnp.int32) + start)
+
+    offs = jnp.arange(n, dtype=jnp.int32) * c
+    _, (vals, ids) = jax.lax.scan(body, None, offs)      # [n, B, k]
+    top_vals, top_ids = merge_topk(vals, ids)
+    return pack_topk(top_vals, top_ids)

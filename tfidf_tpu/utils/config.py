@@ -101,6 +101,29 @@ class Config:
     # commit if an upload landed just before the batch).
     scatter_timeout_s: float = 60.0
 
+    # --- dense retrieval / hybrid fusion (engine/dense.py, ops/dense.py,
+    #     cluster/fusion.py) ---
+    # Per-doc embedding column beside the sparse postings: populated at
+    # ingest by a deterministic embedder, scored on the MXU by a blocked
+    # brute-force matmul top-k, fused with the sparse stage at the
+    # scatter owner-merge. Disabling drops dense/hybrid query modes
+    # (they fail loudly, never silently fall back to sparse).
+    embedding_enabled: bool = True
+    embedding_dim: int = 64
+    # Embedder registry key (engine/embedder.py). "hash" is the hermetic
+    # default: signed feature hashing of token STRINGS via blake2b —
+    # replica-identical vectors with zero learned weights. Real encoders
+    # plug in via register_embedder().
+    embedding_model: str = "hash"
+    # Doc-axis chunk for the blocked dense kernel (rows per matmul).
+    embedding_chunk: int = 1 << 14
+    # Default fusion for mode=hybrid when the query doesn't choose:
+    # "rrf" (reciprocal-rank, scale-free) | "wsum" (min-max weighted sum).
+    fusion_method: str = "rrf"
+    fusion_rrf_k: float = 60.0
+    fusion_weight_sparse: float = 0.5
+    fusion_weight_dense: float = 0.5
+
     # --- analyzer ---
     lowercase: bool = True
     stopwords: tuple[str, ...] = ()   # Lucene 9 StandardAnalyzer default: none

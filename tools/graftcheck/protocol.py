@@ -75,7 +75,7 @@ CONTRACT_HEADERS = frozenset({
     "X-Trace-Id", "X-Span-Id", "X-Route-Epoch", "X-Route-Generation",
     "X-Scatter-Degraded", "X-Deadline-Exceeded", "X-Fence-Rejected",
     "X-Fence-Epoch", "X-Shed-Reason", "Retry-After", "Connection",
-    "X-Proto-Version", "X-Proto-Rejected",
+    "X-Proto-Version", "X-Proto-Rejected", "X-Search-Stages",
 })
 
 _MUTATING_WORKER_PREFIXES = ("/worker/upload", "/worker/delete")

@@ -125,6 +125,20 @@
 #                     mid-scatter — every reply exact or honestly
 #                     X-Scatter-Degraded, never silently partial
 #                     (tests/test_hybrid.py -m slow)
+#   make bench-tier   r18 tiered-postings bench: a synthetic corpus
+#                     provably larger than the hot-set HBM budget,
+#                     phased zipfian search with cold-segment
+#                     skip rate, hot-tier hit rate, upload-ring stall
+#                     time, flat steady-state ingest dps
+#                     (df_full_recomputes asserted zero), and exact
+#                     top-k parity vs the untiered oracle gated on
+#                     every phase; writes BENCH_r12.json
+#   make chaos-tier   slow tiered-storage chaos job: the disk nemesis
+#                     flips bytes in a cold spill file mid-query — the
+#                     rotten spill must be quarantined, repaired from
+#                     the host replica, and every search stays in
+#                     exact untiered-oracle parity
+#                     (tests/test_tiering.py -m slow)
 
 #   make trace-demo   zero-to-aha for the tracing layer: spin a small
 #                     in-process cluster, kill a worker mid-request,
@@ -158,9 +172,9 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition chaos-autopilot chaos-router \
-        chaos-powerloss chaos-upgrade chaos-hybrid scrub \
+        chaos-powerloss chaos-upgrade chaos-hybrid chaos-tier scrub \
         faults bench bench-overload bench-routers bench-kernel \
-        bench-replay bench-hybrid probe-overlap \
+        bench-replay bench-hybrid bench-tier probe-overlap \
         graftcheck lockdep protocol-witness check trace-demo
 
 test:
@@ -185,6 +199,7 @@ lockdep:
 	  tests/test_router.py tests/test_storage.py \
 	  tests/test_commit_stats.py tests/test_upgrade.py \
 	  tests/test_graftcheck.py tests/test_hybrid.py \
+	  tests/test_tiering.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 # Suite choice: test_router drives the stateless-router tier (reads,
@@ -238,6 +253,9 @@ chaos-upgrade:
 chaos-hybrid:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_hybrid.py $(PYTEST_FLAGS) -m slow
 
+chaos-tier:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tiering.py $(PYTEST_FLAGS) -m slow
+
 scrub:
 	python -m tfidf_tpu scrub
 
@@ -264,3 +282,6 @@ bench-replay:
 
 bench-hybrid:
 	BENCH_OUT=BENCH_r11.json python bench.py --hybrid
+
+bench-tier:
+	BENCH_OUT=BENCH_r12.json python bench.py --tier

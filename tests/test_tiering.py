@@ -1,0 +1,407 @@
+"""Tiered postings + block-max skipping (ISSUE 18): soundness pins.
+
+The tiering contract has one non-negotiable invariant — **a skipped or
+cold segment can NEVER change top-k**. Every test here is a face of
+that invariant:
+
+* **exact parity**: after randomized upsert → delete → merge → commit
+  sequences, a tiered engine (including the pathological budget-0
+  config where EVERY search streams through the upload ring) returns
+  bit-identical (name, score) lists to (a) a separate untiered oracle
+  engine fed the same ops and (b) the same engine with
+  ``Searcher.tier_bypass`` forced (score-everything, no skip proofs);
+* **bound soundness**: per-segment block-max bounds
+  (:func:`tfidf_tpu.ops.blockmax.query_upper_bounds`) dominate a
+  host-side f64 scratch recompute of the true max live-doc score, for
+  randomized queries, after deletes and merges — bounds are computed
+  at build time and must stay valid for every later live mask;
+* **adversarial fault-in**: the global top-1 doc living in an evicted
+  segment must be faulted in, not skipped — the exact case a buggy
+  threshold would get wrong silently;
+* **residency accounting**: admit/evict/spill under a byte budget,
+  dense-plane reservation (the PR 17 embedding column cannot silently
+  pin HBM the tier thinks it owns), checkpoint restore re-admission;
+* **witness**: ``df_full_recomputes`` stays at zero for steady-state
+  tiered commits — tiering must not reintroduce the O(corpus) pass;
+* **chaos (slow)**: bit rot injected into a cold spill file is caught
+  by the manifest gate mid-query, the version dir is quarantined, the
+  segment is re-spilled from the host replica, and the search still
+  returns exact oracle parity (``make chaos-tier``).
+"""
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.engine import checkpoint
+from tfidf_tpu.engine.engine import Engine
+from tfidf_tpu.ops.blockmax import query_upper_bounds
+from tfidf_tpu.utils import storage
+from tfidf_tpu.utils.config import Config
+from tfidf_tpu.utils.storage import global_storage
+
+# fixed pool keeps the vocab inside the 64-term capacity bucket, so no
+# commit takes the vocab-growth resync (same idiom as test_commit_stats)
+WORDS = [f"w{i}" for i in range(48)]
+
+
+def make_engine(tmp_path, sub, *, tier=False, budget_mb=0, **kw):
+    cfg = Config(documents_path=str(tmp_path / sub / "docs"),
+                 index_path=str(tmp_path / sub / "index"),
+                 engine_mode="local", index_mode="segments",
+                 tier_enabled=tier, tier_hot_budget_mb=budget_mb,
+                 min_doc_capacity=8, min_nnz_capacity=256,
+                 min_vocab_capacity=64, query_batch=4,
+                 max_query_terms=8, **kw)
+    return Engine(cfg)
+
+
+def close_tier(eng):
+    if getattr(eng, "tier", None) is not None:
+        eng.tier.close()
+
+
+def rand_text(rng, n_lo=3, n_hi=12):
+    n = int(rng.integers(n_lo, n_hi))
+    return " ".join(WORDS[i] for i in rng.integers(0, len(WORDS), n))
+
+
+def hits_key(hits, nd=4):
+    return [(h.name, round(h.score, nd)) for h in hits]
+
+
+def run_queries(eng, queries, k=5):
+    return [hits_key(hits) for hits in eng.search_batch(queries, k=k)]
+
+
+QUERIES = ["w0 w1 w2", "w5", "w10 w11 w12 w13", "w40 w41",
+           "w7 w7 w7 w8", "w20 w30 w44", "w0", "w47 w46 w45"]
+
+
+class TestTieredParity:
+    @pytest.mark.parametrize("seed,budget_mb", [(0, 0), (7, 0), (3, 512)])
+    def test_randomized_upsert_delete_merge_commit(self, tmp_path, seed,
+                                                   budget_mb):
+        """Tiered == untiered oracle == tier_bypass, exactly, across
+        randomized mutation rounds. max_segments=2 forces inline merges
+        nearly every commit, so the merge path's bound recomputation and
+        tier splice (discard sources / admit merged) are both on the
+        hot path of this test."""
+        tiered = make_engine(tmp_path, "t", tier=True, budget_mb=budget_mb,
+                             max_segments=2)
+        oracle = make_engine(tmp_path, "o", max_segments=2)
+        try:
+            rng = np.random.default_rng(seed)
+            names = []
+            for round_ in range(6):
+                for j in range(int(rng.integers(2, 6))):
+                    name = f"d{round_}_{j}.txt"
+                    text = rand_text(rng)
+                    tiered.ingest_text(name, text)
+                    oracle.ingest_text(name, text)
+                    names.append(name)
+                if names and rng.random() < 0.7:       # upsert
+                    victim = names[int(rng.integers(0, len(names)))]
+                    text = rand_text(rng)
+                    tiered.ingest_text(victim, text)
+                    oracle.ingest_text(victim, text)
+                if len(names) > 4 and rng.random() < 0.5:   # delete
+                    victim = names.pop(int(rng.integers(0, len(names))))
+                    tiered.delete(victim)
+                    oracle.delete(victim)
+                tiered.commit()
+                oracle.commit()
+                got = run_queries(tiered, QUERIES)
+                want = run_queries(oracle, QUERIES)
+                assert got == want, f"tiered != oracle at round {round_}"
+                # bypass oracle on the SAME engine: score everything,
+                # no skip proofs — must agree bit-for-bit too
+                tiered.searcher.tier_bypass = True
+                try:
+                    assert run_queries(tiered, QUERIES) == want
+                finally:
+                    tiered.searcher.tier_bypass = False
+                # bypass faulted everything in; re-evict so the next
+                # round exercises the cold path again
+                tiered.tier.rebalance()
+            st = tiered.tier_stats()
+            assert st["enabled"]
+            if budget_mb == 0:
+                # every search streamed through the ring at least once
+                assert st["cold_faults"] > 0
+        finally:
+            close_tier(tiered)
+
+    def test_skip_occurrence_and_zero_bound(self, tmp_path):
+        """A query sharing no term with a cold segment proves it
+        skippable (bound exactly 0) without faulting it in."""
+        eng = make_engine(tmp_path, "s", tier=True, budget_mb=0)
+        try:
+            for i in range(6):
+                eng.ingest_text(f"a{i}.txt", f"w0 w1 w2 w{i % 4}")
+            eng.commit()
+            for i in range(6):
+                eng.ingest_text(f"b{i}.txt", f"w20 w21 w22 w{20 + i % 4}")
+            eng.commit()
+            st0 = eng.tier_stats()
+            hits = eng.search("w20 w21", k=3)
+            assert all(h.name.startswith("b") for h in hits)
+            st1 = eng.tier_stats()
+            assert st1["segments_skipped"] > st0["segments_skipped"], \
+                "the disjoint-vocab segment should be provably skipped"
+            assert st1["cold_segments"] > 0
+            assert st1["skip_rate"] > 0.0
+        finally:
+            close_tier(eng)
+
+    def test_adversarial_cold_segment_holds_top1(self, tmp_path):
+        """The global best doc lives in an evicted segment whose bound
+        EXCEEDS the hot candidates' — it must fault in and win."""
+        eng = make_engine(tmp_path, "adv", tier=True, budget_mb=0)
+        try:
+            # segment 1: the needle — one doc saturated with the query
+            # term (highest tf -> highest bound and highest true score)
+            eng.ingest_text("needle.txt", "w9 " * 12 + "w1")
+            eng.commit()
+            # segment 2: haystack docs that mention w9 once
+            for i in range(6):
+                eng.ingest_text(f"hay{i}.txt", f"w9 w2 w3 w{i % 5}")
+            eng.commit()
+            st0 = eng.tier_stats()
+            hits = eng.search("w9", k=3)
+            assert hits[0].name == "needle.txt"
+            st1 = eng.tier_stats()
+            assert st1["cold_faults"] > st0["cold_faults"], \
+                "the winning segment was served without a cold fault?"
+        finally:
+            close_tier(eng)
+
+
+class TestBoundSoundness:
+    @pytest.mark.parametrize("model", ["bm25", "tfidf"])
+    @pytest.mark.parametrize("seed", [0, 11])
+    def test_bounds_dominate_scratch_recompute(self, tmp_path, model,
+                                               seed):
+        """query_upper_bounds vs an independent f64 scratch scorer over
+        every live host doc of every segment, after randomized mutations
+        — the bound must dominate the true max for every query."""
+        eng = make_engine(tmp_path, f"b{model}{seed}", tier=True,
+                          budget_mb=0, max_segments=2, model=model)
+        try:
+            rng = np.random.default_rng(seed)
+            names = []
+            for round_ in range(5):
+                for j in range(int(rng.integers(3, 7))):
+                    name = f"d{round_}_{j}.txt"
+                    eng.ingest_text(name, rand_text(rng))
+                    names.append(name)
+                if len(names) > 3:
+                    eng.delete(names.pop(int(rng.integers(0, len(names)))))
+                eng.commit()
+            snap = eng.index.snapshot
+            n_docs = float(np.asarray(snap.n_docs))
+            avgdl = float(np.asarray(snap.avgdl))
+            df_host = snap.df_host
+            k1, b = eng.config.bm25_k1, eng.config.bm25_b
+            for _ in range(8):
+                u = int(rng.integers(1, 6))
+                uniq = np.sort(rng.choice(len(WORDS), size=u,
+                                          replace=False)).astype(np.int64)
+                qc = rng.integers(1, 4, size=(1, u)).astype(np.float64)
+                df_u = df_host[uniq].astype(np.float64)
+                for seg in eng.index._segments:
+                    ub = query_upper_bounds(
+                        seg.bounds, uniq, qc, df_u, n_docs, avgdl,
+                        model=model, k1=k1, b=b, margin=0.0)
+                    best = 0.0
+                    for d, alive in zip(seg.host_docs, seg.live):
+                        if not alive:
+                            continue
+                        pos = np.searchsorted(d.term_ids, uniq)
+                        pos_c = np.minimum(pos,
+                                           max(d.term_ids.shape[0] - 1, 0))
+                        if d.term_ids.shape[0] == 0:
+                            continue
+                        m = d.term_ids[pos_c] == uniq
+                        if not m.any():
+                            continue
+                        tf = d.tfs[pos_c[m]].astype(np.float64)
+                        dfm = df_u[m]
+                        if model == "bm25":
+                            dl = float(eng.model.transform_doc_len(
+                                np.asarray([d.length], np.float32))[0])
+                            idf = np.log1p((n_docs - dfm + 0.5)
+                                           / (dfm + 0.5))
+                            norm = k1 * (1.0 - b + b * dl
+                                         / max(avgdl, 1e-9))
+                            w = idf * tf / (tf + norm)
+                        else:
+                            w = (np.log((1.0 + n_docs) / (1.0 + dfm))
+                                 + 1.0) * tf
+                        best = max(best, float((qc[0, m] * w).sum()))
+                    assert best <= float(ub[0]) + 1e-9, \
+                        (f"bound {ub[0]} < true max {best} for seg "
+                         f"{seg.tier_uid} terms {uniq.tolist()}")
+        finally:
+            close_tier(eng)
+
+
+class TestResidencyAccounting:
+    def test_budget_zero_spills_everything(self, tmp_path):
+        eng = make_engine(tmp_path, "z", tier=True, budget_mb=0)
+        try:
+            rng = np.random.default_rng(2)
+            for r in range(3):
+                for i in range(4):
+                    eng.ingest_text(f"d{r}_{i}.txt", rand_text(rng))
+                eng.commit()
+            st = eng.tier_stats()
+            assert st["hot_segments"] == 0
+            assert st["cold_segments"] == len(eng.index._segments)
+            assert st["spills"] >= st["cold_segments"]
+            assert st["hot_bytes"] == 0
+        finally:
+            close_tier(eng)
+
+    def test_big_budget_keeps_everything_hot(self, tmp_path):
+        eng = make_engine(tmp_path, "h", tier=True, budget_mb=512)
+        try:
+            rng = np.random.default_rng(3)
+            for r in range(3):
+                for i in range(4):
+                    eng.ingest_text(f"d{r}_{i}.txt", rand_text(rng))
+                eng.commit()
+            base_faults = eng.tier_stats()["cold_faults"]
+            eng.search_batch(QUERIES, k=5)
+            st = eng.tier_stats()
+            assert st["cold_segments"] == 0
+            assert st["hot_segments"] == len(eng.index._segments)
+            assert st["cold_faults"] == base_faults == 0
+            assert st["hot_hits"] > 0
+            assert 0 < st["hot_bytes"] <= st["budget_bytes"]
+            assert st["hit_rate"] == 1.0
+        finally:
+            close_tier(eng)
+
+    def test_dense_plane_reserved_bytes(self, tmp_path):
+        """PR 17's embedding column carves its device bytes out of the
+        tier budget — it must show up in reserved_bytes, never be
+        silently pinned on top of a 'full' budget."""
+        eng = make_engine(tmp_path, "dr", tier=True, budget_mb=512,
+                          embedding_enabled=True)
+        try:
+            rng = np.random.default_rng(4)
+            for i in range(6):
+                eng.ingest_text(f"d{i}.txt", rand_text(rng))
+            eng.commit()
+            ds = eng.dense.stats()
+            assert ds["device_bytes"] > 0
+            assert ds["host_bytes"] > 0
+            assert ds["bytes"] == ds["device_bytes"] + ds["host_bytes"]
+            assert eng.tier_stats()["reserved_bytes"] == ds["device_bytes"]
+        finally:
+            close_tier(eng)
+
+    def test_df_witness_zero_under_tiering(self, tmp_path):
+        """Tiering must not reintroduce the O(corpus) stat pass: after
+        the first commit, steady-state tiered commits (with searches
+        between — fault-ins included) never bump df_full_recomputes."""
+        eng = make_engine(tmp_path, "w", tier=True, budget_mb=0)
+        try:
+            rng = np.random.default_rng(5)
+            for i in range(4):
+                eng.ingest_text(f"d{i}.txt", rand_text(rng))
+            eng.commit()
+            base = eng.index.df_full_recomputes
+            assert base == 1           # first commit only
+            for r in range(4):
+                eng.ingest_text(f"n{r}.txt", rand_text(rng))
+                eng.ingest_text("d0.txt", rand_text(rng))    # upsert
+                eng.commit()
+                eng.search_batch(QUERIES[:3], k=5)
+            eng.delete("d1.txt")
+            eng.commit()
+            assert eng.index.df_full_recomputes == base, \
+                "a steady-state tiered commit took the full recompute"
+        finally:
+            close_tier(eng)
+
+    def test_checkpoint_roundtrip_readmits_segments(self, tmp_path):
+        """Restore rebuilds segments fully resident; install_full_state
+        must register each with the tier so the budget rebalance sees
+        them — and parity must hold through the round trip."""
+        eng = make_engine(tmp_path, "ck", tier=True, budget_mb=0)
+        eng2 = None
+        try:
+            rng = np.random.default_rng(6)
+            for r in range(3):
+                for i in range(3):
+                    eng.ingest_text(f"d{r}_{i}.txt", rand_text(rng))
+                eng.commit()
+            want = run_queries(eng, QUERIES)
+            ckdir = str(tmp_path / "ck" / "ckpt")
+            checkpoint.save_checkpoint(eng, ckdir)
+            eng2 = checkpoint.load_checkpoint(ckdir, config=eng.config)
+            assert eng2.tier is not None
+            st = eng2.tier_stats()
+            assert (st["hot_segments"] + st["cold_segments"]
+                    == len(eng2.index._segments))
+            # budget 0: the restore-time rebalance re-spilled everything
+            assert st["cold_segments"] == len(eng2.index._segments)
+            assert run_queries(eng2, QUERIES) == want
+        finally:
+            close_tier(eng)
+            if eng2 is not None:
+                close_tier(eng2)
+
+    def test_cosine_refuses_tiering(self, tmp_path):
+        """Per-doc cosine norms depend on the moving global df — no
+        sound block-max bound exists, so the engine must refuse loudly
+        instead of serving unsound skips."""
+        with pytest.raises(ValueError, match="cosine"):
+            make_engine(tmp_path, "cos", tier=True, budget_mb=0,
+                        model="tfidf_cosine")
+
+
+@pytest.mark.slow
+class TestColdTierChaos:
+    def test_bitrot_on_cold_spill_quarantine_repair_parity(self,
+                                                           tmp_path):
+        """Bit rot lands on a cold spill file between commit and query.
+        The manifest gate in front of the mmap fault-in must catch it,
+        quarantine the version dir, re-spill from the host replica, and
+        the query must still return exact untiered-oracle parity
+        (``make chaos-tier``)."""
+        tiered = make_engine(tmp_path, "rot_t", tier=True, budget_mb=0)
+        oracle = make_engine(tmp_path, "rot_o")
+        try:
+            rng = np.random.default_rng(7)
+            for r in range(3):
+                for i in range(4):
+                    name, text = f"d{r}_{i}.txt", rand_text(rng)
+                    tiered.ingest_text(name, text)
+                    oracle.ingest_text(name, text)
+                tiered.commit()
+                oracle.commit()
+            st0 = tiered.tier_stats()
+            assert st0["cold_segments"] == len(tiered.index._segments)
+            # arm one-shot rot on the first tf block of any spill — the
+            # next integrity read through the seam flips a byte
+            global_storage.arm(storage.BITROT, "*b0_tf.bin",
+                               keep_bytes=3, times=1)
+            got = run_queries(tiered, QUERIES)
+            want = run_queries(oracle, QUERIES)
+            assert got == want, "parity lost after mid-query bit rot"
+            st1 = tiered.tier_stats()
+            assert st1["quarantines"] >= 1, \
+                "armed rot was never detected by the manifest gate"
+            assert st1["repairs"] >= 1
+            # the repaired spill must be clean: evict + re-fault with no
+            # further quarantines
+            tiered.tier.rebalance()
+            assert run_queries(tiered, QUERIES) == want
+            assert tiered.tier_stats()["quarantines"] == \
+                st1["quarantines"]
+        finally:
+            global_storage.heal()
+            close_tier(tiered)
+            close_tier(oracle)

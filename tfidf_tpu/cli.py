@@ -605,6 +605,11 @@ def cmd_status(args) -> int:
     # dims, docs embedded, bytes resident. A member with the dense
     # plane off (or predating it) simply has no row.
     columns = []
+    # tiered-postings summary (README "Tiered storage & block-max
+    # skipping"): per-member hot/cold segment counts, HBM bytes vs
+    # budget, hit/skip rates from the same sweep. A member with
+    # tiering off (or predating it) simply has no row.
+    tiers = []
     for role, member in members:
         try:
             h = json.loads(http_get(
@@ -622,6 +627,17 @@ def cmd_status(args) -> int:
                                 "docs_embedded": int(emb.get("docs", 0)),
                                 "bytes_resident":
                                     int(emb.get("bytes", 0))})
+            tier = h.get("tier")
+            if tier and tier.get("enabled"):
+                tiers.append({
+                    "url": member,
+                    "hot_segments": int(tier.get("hot_segments", 0)),
+                    "cold_segments": int(tier.get("cold_segments", 0)),
+                    "hot_bytes": int(tier.get("hot_bytes", 0)),
+                    "budget_bytes": int(tier.get("budget_bytes", 0)),
+                    "hit_rate": tier.get("hit_rate", 0.0),
+                    "skip_rate": tier.get("skip_rate", 0.0),
+                    "ring_stall_s": tier.get("ring_stall_s", 0.0)})
         except Exception:
             versions.append({"url": member, "role": role,
                              "proto_version": None,
@@ -640,6 +656,13 @@ def cmd_status(args) -> int:
             sum(c["docs_embedded"] for c in columns),
         "bytes_resident_total":
             sum(c["bytes_resident"] for c in columns),
+    }
+    out["tier"] = {
+        "enabled": bool(tiers),
+        "nodes": tiers,
+        "hot_segments_total": sum(t["hot_segments"] for t in tiers),
+        "cold_segments_total": sum(t["cold_segments"] for t in tiers),
+        "hot_bytes_total": sum(t["hot_bytes"] for t in tiers),
     }
     out["admission"] = {
         "admitted_total": int(metrics.get("admission_admitted", 0)),

@@ -366,6 +366,38 @@ class SlowTripController(KnobController):
             "workers": len(ewmas), "spread_mult": self.mult}
 
 
+class TierBudgetController(KnobController):
+    """``tier_hot_budget_mb`` (ISSUE 18) steered toward the configured
+    tier hit-rate target: a window whose hot-tier hit rate falls short
+    of ``tier_hit_target`` grows the HBM budget proportionally to the
+    shortfall (more segments stay resident, fewer searches stall on the
+    upload ring); a window comfortably over it shrinks the budget and
+    returns HBM to whatever else wants it (the dense carve-out, larger
+    query batches). Skipped segments count as neither hit nor fault —
+    a skip costs nothing, so it must not dilute the pressure signal.
+    Needs real tier traffic: a window with too few lookups (everything
+    skipped, or no queries) carries no signal."""
+
+    def __init__(self, cfg, read, write) -> None:
+        super().__init__("tier_hot_budget_mb",
+                         cfg.autopilot_tier_floor_mb,
+                         cfg.autopilot_tier_ceiling_mb,
+                         read, write, cfg.tier_hot_budget_mb,
+                         integral=True)
+        self.target = min(max(cfg.tier_hit_target, 0.0), 1.0)
+        self.min_window = cfg.autopilot_min_window
+
+    def sense(self, frame, current):
+        lookups = frame["tier_hits"] + frame["tier_faults"]
+        if lookups < self.min_window:
+            return None
+        rate = frame["tier_hits"] / lookups
+        inputs = {"tier_hit_rate": round(rate, 3),
+                  "tier_lookups": int(lookups),
+                  "hit_target": self.target}
+        return current * (1.0 + (self.target - rate)), inputs
+
+
 class Autopilot:
     """The leader-side control loop. Constructed on every node (like
     the rebalancer); ``maybe_run`` is called from the reconcile sweep
@@ -430,6 +462,15 @@ class Autopilot:
                     cfg,
                     read=lambda: b.linger_bounds()[1] * 1e3,
                     write=lambda v: b.set_linger_bounds(hi_s=v / 1e3)))
+        # the tier-budget controller only exists where a tiered
+        # segmented index is serving (engine.tier) — it steers this
+        # node's hot-set HBM budget toward the tier hit-rate target
+        tier = getattr(node.engine, "tier", None)
+        if tier is not None:
+            self.controllers.append(TierBudgetController(
+                cfg,
+                read=lambda: float(tier.budget_bytes >> 20),
+                write=lambda v: tier.set_budget(int(v) << 20)))
         # the critical/high ratio the watermark controller preserves
         hw = max(1, cfg.admission_queue_high_water)
         self._critical_ratio = (cfg.admission_queue_critical / hw
@@ -443,6 +484,8 @@ class Autopilot:
         self._c_batches = CounterWindow("scatter_batches")
         self._c_items = CounterWindow("scatter_items")
         self._c_sheds = CounterWindow("admission_shed_total")
+        self._c_tier_hits = CounterWindow("tier_hot_hits")
+        self._c_tier_faults = CounterWindow("tier_cold_faults")
 
         # windows start NOW: the first control pass must see only what
         # happened since this autopilot existed, not the process's
@@ -516,6 +559,8 @@ class Autopilot:
             "batches": self._c_batches.advance(),
             "items": self._c_items.advance(),
             "sheds": self._c_sheds.advance(),
+            "tier_hits": self._c_tier_hits.advance(),
+            "tier_faults": self._c_tier_faults.advance(),
             "depth": depth,
             "max_batch": b.max_batch if b is not None else 0,
             "worker_ewmas": self.node.resilience.latency_snapshot(),
@@ -709,7 +754,8 @@ class Autopilot:
     def _reset_windows(self) -> None:
         for w in (self._w_scatter, self._w_leader):
             w.advance()
-        for c in (self._c_batches, self._c_items, self._c_sheds):
+        for c in (self._c_batches, self._c_items, self._c_sheds,
+                  self._c_tier_hits, self._c_tier_faults):
             c.advance()
 
     # ---- audit trail ----

@@ -2551,7 +2551,13 @@ class _NodeHandler(_HttpHandlerBase):
                     # embedding-column summary (dims, docs embedded,
                     # bytes resident) for the CLI status fan-out; null
                     # when the dense plane is disabled
-                    "embedding": node.engine.dense_stats()})
+                    "embedding": node.engine.dense_stats(),
+                    # tiered-postings residency counters (ISSUE 18):
+                    # hot/cold segment counts, HBM bytes vs budget,
+                    # hit/skip rates — {"enabled": false} when off.
+                    # JSON body only; no header/endpoint change, so
+                    # the wire fingerprint is untouched.
+                    "tier": node.engine.tier_stats()})
             elif u.path == "/worker/index-size":
                 self._text(str(node.engine.index_size_bytes()))
             elif u.path == "/worker/names":

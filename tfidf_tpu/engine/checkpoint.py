@@ -165,6 +165,11 @@ def save_checkpoint(engine: Engine, directory: str) -> None:
         "vocab_size": len(engine.vocab),
         "snapshot": snap_meta,
         "embedding": emb_meta,
+        # tier residency at save time (ISSUE 18) — informational: a
+        # restore reinstalls everything resident and the first tier
+        # rebalance re-spills to whatever budget the RUNNING config
+        # sets; the checkpoint never pins the old residency split
+        "tier": engine.tier_stats(),
         # wall-clock save time: serve's boot re-walk only re-ingests
         # files modified after this (minus slack), keeping the
         # reference's rebuild-from-documents property without paying

@@ -184,5 +184,9 @@ class EmbeddingColumn:
         host = len(self._vecs) * self.dim * 4
         dev = (int(self._emb_dev.size) * 4
                if self._emb_dev is not None else 0)
+        # host/device split separately: the device snapshot is a
+        # carve-out of the tier HBM budget (engine.commit wires it into
+        # TierManager.set_reserved), while host truth is RAM-only
         return {"model": self.embedder.name, "dim": self.dim,
-                "docs": len(self._vecs), "bytes": host + dev}
+                "docs": len(self._vecs), "bytes": host + dev,
+                "host_bytes": host, "device_bytes": dev}

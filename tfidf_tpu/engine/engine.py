@@ -48,6 +48,7 @@ class Engine:
         # because ingest_bytes -> ingest_text nests
         self._write_lock = threading.RLock()
         self.dense = None    # set below; stays None for mesh layouts
+        self.tier = None     # set below for tiered segments mode only
         self.analyzer = Analyzer(
             lowercase=c.lowercase,
             stopwords=frozenset(c.stopwords),
@@ -124,6 +125,19 @@ class Engine:
                 pipeline_mode=c.search_pipeline_mode)
             return
         if c.index_mode == "segments":
+            # tiered postings (ISSUE 18): device-resident hot set +
+            # mmap-backed cold tier with block-max skipping. Loud on a
+            # cosine model — no sound per-segment upper bound exists
+            # there, and silently serving untiered would fake the
+            # memory-footprint contract the knob promises.
+            if c.tier_enabled:
+                from tfidf_tpu.engine.tiering import TierManager
+                cold = c.tier_cold_dir or os.path.join(
+                    c.index_path, "cold")
+                self.tier = TierManager(
+                    cold, int(c.tier_hot_budget_mb) << 20,
+                    ring_depth=c.tier_ring_depth,
+                    skip_margin=c.tier_skip_margin)
             self.index = SegmentedIndex(
                 self.model,
                 min_doc_cap=c.min_doc_capacity,
@@ -132,7 +146,8 @@ class Engine:
                 sync_merge_nnz=c.sync_merge_nnz,
                 merge_upload_pace=c.merge_upload_pace,
                 merge_workers=c.merge_workers,
-                incremental_stats=c.df_incremental)
+                incremental_stats=c.df_incremental,
+                tier=self.tier)
         else:
             self.index = ShardIndex(
                 self.model,
@@ -319,6 +334,13 @@ class Engine:
             self.index.commit(self.vocab.capacity())
             if self.dense is not None:
                 self.dense.commit()
+                if self.tier is not None:
+                    # the dense snapshot is a carve-out of the same HBM
+                    # the hot sparse set competes for (ISSUE 18 satellite:
+                    # the hybrid plane must not silently pin the whole
+                    # embedding matrix outside the budget accounting)
+                    self.tier.set_reserved(
+                        int(self.dense.stats()["device_bytes"]))
         log.info("commit", ms=sw.ms, docs=self.index.num_live_docs)
 
     def build_from_directory(self, docs_path: str | None = None,
@@ -411,6 +433,16 @@ class Engine:
         """Embedding-column summary for /api/health and `status` — None
         when the dense plane is off."""
         return self.dense.stats() if self.dense is not None else None
+
+    # ---- tiered postings (ISSUE 18) ----
+
+    def tier_stats(self) -> dict:
+        """Tier residency/skip summary for /api/health and `status` —
+        ``{"enabled": False}`` when tiering is off so callers never
+        branch on None."""
+        if self.tier is None:
+            return {"enabled": False}
+        return self.tier.stats()
 
     # ---- files (Worker.workerDownload analog) ----
 

@@ -25,6 +25,7 @@ from tfidf_tpu.engine.segments import SegmentedSnapshot
 from tfidf_tpu.engine.vocab import Vocabulary
 from tfidf_tpu.models.base import ScoringModel
 from tfidf_tpu.ops.analyzer import Analyzer
+from tfidf_tpu.ops.blockmax import query_upper_bounds, skip_mask
 from tfidf_tpu.ops.csr import next_capacity
 from tfidf_tpu.ops.ell import score_ell_batch, score_segments_batch
 from tfidf_tpu.ops.scoring import (QueryBatch, make_query_batch,
@@ -310,8 +311,14 @@ class Searcher(QueryVectorizerMixin):
             qb, _widest = self._vectorize(queries, cap)
         with trace_phase("score"):
             if isinstance(snap, SegmentedSnapshot):
+                # tiered snapshots publish no eager views; materialize
+                # them all (faulting in the whole cold tier) — this IS
+                # the untiered computation, used by the unbounded path
+                # and the tier_bypass parity oracle
+                views = (snap.views if snap.tier is None
+                         else snap.tier.all_views(snap))
                 scores = score_segments_batch(
-                    snap.views, snap.df, qb, snap.n_docs, snap.avgdl,
+                    views, snap.df, qb, snap.n_docs, snap.avgdl,
                     **self.model.score_kwargs())
             elif snap.is_ell:
                 # gather fast path: impacts precomputed at commit;
@@ -331,14 +338,177 @@ class Searcher(QueryVectorizerMixin):
                     **self.model.score_kwargs())
         return scores
 
+    # oracle switch: True forces tiered snapshots through the untiered
+    # scoring path (every segment faulted + scored) — the parity
+    # baseline bench/chaos runs compare the skipping path against
+    tier_bypass = False
+
     def _dispatch_chunk(self, snap: Snapshot, queries: list[str],
                         k: int):
         """Launch one chunk's device work; returns (packed, kk) with the
         packed top-k still ON DEVICE (not fetched)."""
+        if isinstance(snap, SegmentedSnapshot) and snap.tier is not None \
+                and not self.tier_bypass:
+            return self._dispatch_tiered(snap, queries, k)
         scores = self._score_chunk(snap, queries)
         with trace_phase("topk"):
             kk = min(k, snap.num_names)
             return packed_topk_chunked(scores, snap.num_docs, k=kk), kk
+
+    def _dispatch_tiered(self, snap: SegmentedSnapshot,
+                         queries: list[str], k: int):
+        """Tiered top-k: score the HOT segments in one device program,
+        then walk the COLD segments in descending bound order, skipping
+        every segment whose block-max upper bound proves it cannot beat
+        the current kk-th positive candidate and faulting in the rest
+        through the upload ring (next candidates prefetched so the
+        host→HBM transfer hides behind scoring).
+
+        Exactness: per-view outputs of ``score_segments_impl`` are
+        independent, so scoring a segment alone is bit-identical to its
+        slice of the full concat; (hot top-kk ∪ each scored cold
+        segment's top-kk) ⊇ the global top-kk over live positive docs;
+        skipped segments are provably below the kk-th positive
+        candidate (STRICT bound comparison — an equal score could still
+        displace on the (-score, gid) tie-break, so equality faults
+        in). The host merge reproduces ``lax.top_k``'s order: descending
+        score, ascending gid on ties. Returns a HOST buffer in the
+        packed [B, 2·kk] wire layout (``fetch_packed`` is a no-op on
+        host arrays)."""
+        import jax.numpy as jnp
+
+        tier = snap.tier
+        B = len(queries)
+        cap = self._batch_cap(B)
+        kk = min(k, snap.num_names)
+        skw = self.model.score_kwargs()
+        with trace_phase("vectorize"):
+            qb, _widest = self._vectorize(queries, cap)
+
+        # ---- hot pass: one device program over the resident set ----
+        cand_vals = np.zeros((B, 0), np.float64)
+        cand_gids = np.zeros((B, 0), np.int64)
+
+        def add_candidates(vals, gids):
+            nonlocal cand_vals, cand_gids
+            cand_vals = np.concatenate(
+                [cand_vals, vals.astype(np.float64)], axis=1)
+            cand_gids = np.concatenate(
+                [cand_gids, gids.astype(np.int64)], axis=1)
+
+        if snap.hot:
+            with trace_phase("score_hot"):
+                hot_views = tuple(v for _i, _b, v in snap.hot)
+                hot_caps = [v.live_mask.shape[0] for v in hot_views]
+                hot_total = int(sum(hot_caps))
+                scores = score_segments_batch(
+                    hot_views, snap.df, qb, snap.n_docs, snap.avgdl,
+                    **skw)
+                kk_h = min(kk, hot_total)
+                packed = packed_topk_chunked(
+                    scores, jnp.int32(hot_total), k=kk_h)
+                hvals, hids = unpack_topk(np.asarray(packed))
+            # concat-local index -> global gid (hot segments need not
+            # be contiguous in the snapshot's gid space)
+            offs = np.cumsum([0] + hot_caps)
+            hbase = np.asarray([b for _i, b, _v in snap.hot], np.int64)
+            seg_of = np.searchsorted(offs, hids[:B], side="right") - 1
+            gids = hbase[seg_of] + (hids[:B] - offs[seg_of])
+            add_candidates(hvals[:B], gids)
+            tier.touch_hot([snap.segments[i] for i, _b, _v in snap.hot])
+
+        # ---- block-max bounds for every cold segment ----
+        def thresholds() -> np.ndarray:
+            """Per query: the kk-th largest strictly-positive candidate
+            (-inf when fewer than kk positives exist — only positive
+            scores fill the result quota)."""
+            pos = np.where(cand_vals > 0.0, cand_vals, -np.inf)
+            if pos.shape[1] < kk:
+                return np.full(B, -np.inf)
+            return -np.partition(-pos, kk - 1, axis=1)[:, kk - 1]
+
+        handles = list(snap.cold)
+        ub_of = {}
+        if handles:
+            U = int(qb.n_uniq)
+            u_cap = qb.uniq.shape[0]
+            # per-query f64 term weights in the batch's compact slot
+            # space (the host mirror of _compile_queries' qc_ext;
+            # column u_cap collects the pad writes and is dropped)
+            qc = np.zeros((cap, u_cap + 1), np.float64)
+            rows = np.repeat(np.arange(cap), qb.slots.shape[1])
+            np.add.at(qc, (rows, np.asarray(qb.slots).reshape(-1)),
+                      np.asarray(qb.weights,
+                                 np.float64).reshape(-1))
+            qc = qc[:B, :U]   # REAL query rows only: a padded row's
+            # qc is all-zero -> bound exactly 0 -> always skippable
+            uniq_terms = np.asarray(qb.uniq[:U]).astype(np.int64)
+            df_u = snap.df_host[uniq_terms].astype(np.float64)
+            n_docs_f = float(snap.n_docs)
+            avgdl_f = float(snap.avgdl)
+            for h in handles:
+                ub_of[id(h)] = query_upper_bounds(
+                    h.bounds, uniq_terms, qc, df_u, n_docs_f, avgdl_f,
+                    margin=tier.skip_margin,
+                    **{kw: skw[kw] for kw in ("model", "k1", "b")
+                       if kw in skw})
+            # visit the likeliest contributors first: thresholds only
+            # rise as candidates accumulate, so a high-bound-first walk
+            # maximizes how many later segments prove skippable
+            handles.sort(key=lambda h: -float(ub_of[id(h)].max())
+                         if ub_of[id(h)].shape[0] else 0.0)
+        tier.note_considered(len(handles))
+
+        # ---- cold walk: skip by bound, else fault in + score ----
+        skipped = 0
+        for pos_i, h in enumerate(handles):
+            thresh = thresholds()
+            if tier.skip_enabled \
+                    and skip_mask(ub_of[id(h)], thresh).all():
+                skipped += 1
+                continue
+            # queue THIS segment's upload first, then prefetch the
+            # upcoming survivors ring_depth deep — the single-worker
+            # ring preserves submission order, so the wait below blocks
+            # on this segment only while the next uploads stream behind
+            # the scoring that follows
+            tier.prefetch(h.seg)
+            for nh in handles[pos_i + 1:pos_i + 1 + tier.ring_depth]:
+                if not tier.skip_enabled \
+                        or not skip_mask(ub_of[id(nh)], thresh).all():
+                    tier.prefetch(nh.seg)
+            view = tier.handle_view(h)
+            with trace_phase("score_cold"):
+                seg_scores = score_segments_batch(
+                    (view,), snap.df, qb, snap.n_docs, snap.avgdl,
+                    **skw)
+                cap_i = int(view.live_mask.shape[0])
+                kk_i = min(kk, cap_i)
+                packed = packed_topk(seg_scores, jnp.int32(cap_i),
+                                     k=kk_i)
+                svals, sids = unpack_topk(np.asarray(packed))
+            add_candidates(svals[:B], sids[:B].astype(np.int64) + h.base)
+        tier.note_skips(skipped)
+
+        # ---- host merge into the packed wire layout ----
+        with trace_phase("topk"):
+            C = cand_vals.shape[1]
+            if C < kk:   # fewer candidate lanes than the quota: pad
+                pad = kk - C
+                cand_vals = np.concatenate(
+                    [cand_vals, np.full((B, pad), -np.inf)], axis=1)
+                cand_gids = np.concatenate(
+                    [cand_gids, np.zeros((B, pad), np.int64)], axis=1)
+            order = np.lexsort((cand_gids, -cand_vals),
+                               axis=-1)[:, :kk]
+            rsel = np.arange(B)[:, None]
+            top_v = np.ascontiguousarray(
+                cand_vals[rsel, order].astype(np.float32))
+            top_g = cand_gids[rsel, order].astype(np.int32)
+            arr = np.zeros((B, 2 * kk), np.int32)
+            arr[:, :kk] = top_v.view(np.int32)
+            arr[:, kk:] = top_g
+        return arr, kk
 
     def _finish_chunk(self, snap: Snapshot, queries: list[str],
                       packed, kk: int) -> list[list[SearchHit]]:

@@ -52,6 +52,7 @@ import numpy as np
 
 from tfidf_tpu.engine.index import DocEntry
 from tfidf_tpu.models.base import ScoringModel
+from tfidf_tpu.ops.blockmax import bounds_from_entries
 from tfidf_tpu.ops.csr import CooShard, next_capacity
 from tfidf_tpu.ops.dfdelta import DfDeltaApplier
 from tfidf_tpu.ops.ell import SegmentView, build_ell_from_coo
@@ -94,6 +95,14 @@ class Segment:
     # REUSED across commits instead of rebuilt+re-uploaded
     live_version: int = 0
     view_cache: tuple | None = None   # (live_version, SegmentView)
+    # ---- tiering (engine/tiering.py) — inert without a TierManager ----
+    bounds: object | None = None  # blockmax.SegmentBounds (skip proofs)
+    cold: object | None = None    # tiering.ColdFiles once spilled
+    resident: bool = True         # device arrays present in HBM
+    device_bytes: int = 0         # HBM footprint when resident
+    res_epoch: int = 0            # bumped on evict: invalidates views
+    tier_uid: int = 0             # spill-dir naming
+    tier_seq: int = 0             # LRU clock
 
     @property
     def n_docs(self) -> int:
@@ -158,6 +167,14 @@ class SegmentedSnapshot:
     num_docs: jax.Array   # i32 scalar (total caps, for topk masking)
     version: int = 0
     nnz: int = 0
+    # ---- tiering: when ``tier`` is set, ``views`` is EMPTY and the
+    # segment set is partitioned into ``hot`` (seg_index, gid base,
+    # SegmentView) triples and ``cold`` ColdHandles (captured live
+    # masks + block-max bounds); the searcher takes the tiered dispatch
+    # path instead of scoring ``views`` ----
+    hot: tuple = ()
+    cold: tuple = ()
+    tier: object | None = None
 
     # searcher compatibility surface
     @property
@@ -203,6 +220,16 @@ class SegmentedSnapshot:
         except IndexError:
             return None
 
+    @property
+    def df_host(self) -> np.ndarray:
+        """Host copy of the global df (block-max bound evaluation reads
+        a handful of entries per query batch; fetched once, cached)."""
+        cached = getattr(self, "_df_host", None)
+        if cached is None:
+            cached = np.asarray(self.df)
+            object.__setattr__(self, "_df_host", cached)
+        return cached
+
 
 class SegmentedIndex:
     """Streaming shard index with the same write API as ShardIndex."""
@@ -216,8 +243,18 @@ class SegmentedIndex:
                  sync_merge_nnz: int = 1 << 20,
                  merge_upload_pace: float = 1.0,
                  merge_workers: int = 2,
-                 incremental_stats: bool = True) -> None:
+                 incremental_stats: bool = True,
+                 tier=None) -> None:
         self.model = model
+        # tiered residency (engine/tiering.py): None = everything stays
+        # device-resident (the pre-tiering behavior, bit for bit)
+        if tier is not None and model.needs_norms:
+            # cosine norms depend on the moving global df: no sound
+            # block-max bound and no df-independent cold layout exists
+            raise ValueError("tiering is not supported for cosine models")
+        self.tier = tier
+        if tier is not None:
+            tier.bind(self)
         self.min_doc_cap = min_doc_cap
         self.ell_width_cap = ell_width_cap
         self.max_segments = max_segments
@@ -618,6 +655,23 @@ class SegmentedIndex:
                 res_tf=res_tf, res_term=res_term, res_doc=res_doc,
                 doc_len_d=doc_len_d,
                 nnz_total=int(data[f"s{i}_nnz"]), live=live)
+            dbytes = sum(data[f"s{i}_b{j}_tf"].nbytes
+                         + data[f"s{i}_b{j}_term"].nbytes
+                         + 8 * data[f"s{i}_b{j}_tf"].shape[0]
+                         for j in range(int(data[f"s{i}_nb"])))
+            if res_tf is not None:
+                dbytes += (data[f"s{i}_res_tf"].nbytes
+                           + data[f"s{i}_res_term"].nbytes
+                           + data[f"s{i}_res_doc"].nbytes
+                           + doc_len.nbytes)
+            seg.device_bytes = int(dbytes)
+            if self.tier is not None:
+                # dead placeholders restore with empty postings: the
+                # bound covers a superset and min_dl over placeholders
+                # only loosens it — sound either way
+                min_dl = float(doc_len[:n].min()) if n else 0.0
+                seg.bounds = bounds_from_entries(host_docs, len(seg.df),
+                                                 min_dl)
             segs.append(seg)
             for local, alive in enumerate(live):
                 if alive:
@@ -633,6 +687,12 @@ class SegmentedIndex:
             self._nnz_live_stat = nnz
             self._bytes_live_stat = nbytes
             self._gen += 1
+            if self.tier is not None:
+                # restored segments arrive fully resident — register
+                # each with the tier so residency accounting sees them
+                # and the budget rebalance can spill the overflow
+                for seg in segs:
+                    self.tier.admit(seg)
         global_metrics.inc("docs_indexed", len(entries))
 
     # ---- commit ----
@@ -716,6 +776,11 @@ class SegmentedIndex:
                     time.sleep(pace * (time.perf_counter() - u0))
         else:
             res_tf = res_term = res_doc = doc_len_d = None
+        dbytes = sum(b.tf.nbytes + b.term.nbytes + 8 * b.tf.shape[0]
+                     for b in ell.blocks)      # + dl/norms0 f32 per row
+        if ell.res_nnz:
+            dbytes += (ell.res_tf.nbytes + ell.res_term.nbytes
+                       + ell.res_doc.nbytes + doc_len.nbytes)
         seg = Segment(
             tfs=tuple(tfs_d), terms=tuple(terms_d), dls=tuple(dls_d),
             norms0=tuple(norms0),
@@ -725,7 +790,10 @@ class SegmentedIndex:
             df=df, raw_len=raw_len, host_docs=entries,
             res_tf=res_tf, res_term=res_term, res_doc=res_doc,
             doc_len_d=doc_len_d, nnz_total=nnz,
-            live=np.ones(n, bool))
+            live=np.ones(n, bool), device_bytes=int(dbytes))
+        if self.tier is not None:
+            min_dl = float(doc_len[:n].min()) if n else 0.0
+            seg.bounds = bounds_from_entries(entries, vocab_cap, min_dl)
         seg.sparse_df()   # populate off the write lock (splice holds it)
         return seg
 
@@ -806,6 +874,12 @@ class SegmentedIndex:
                         self._where[d.name] = (new_seg, local)
                     self._segments.append(new_seg)
                     self._stats_add_segment_locked(new_seg)
+                    if self.tier is not None:
+                        # account BEFORE the merge policy (which may
+                        # merge the fresh segment away and discard it);
+                        # over budget this evicts LRU segments, which
+                        # publish as cold handles below
+                        self.tier.admit(new_seg)
                 if len(self._segments) > self.max_segments:
                     self._merge_policy_locked(vocab_cap)
                 segments = list(self._segments)
@@ -849,9 +923,36 @@ class SegmentedIndex:
                     v = min(self._df_total.shape[0], vocab_cap)
                     df_host[:v] = self._df_total[:v]
                 v0 = time.perf_counter()
-                views = tuple(self._make_view(seg, df_host,
-                                              float(total_count))
-                              for seg in segments)
+                if self.tier is None:
+                    views = tuple(self._make_view(seg, df_host,
+                                                  float(total_count))
+                                  for seg in segments)
+                    hot: tuple = ()
+                    cold: tuple = ()
+                else:
+                    from tfidf_tpu.engine.tiering import ColdHandle
+                    views = ()
+                    hot_l, cold_l, base = [], [], 0
+                    for i, seg in enumerate(segments):
+                        if seg.resident:
+                            hot_l.append((i, base, self._make_view(
+                                seg, df_host, float(total_count))))
+                        else:
+                            # capture the live mask NOW (tombstones
+                            # mutate seg.live in place after publish;
+                            # the snapshot must keep the commit-time
+                            # view — same isolation hot views get)
+                            mask = np.zeros(seg.doc_cap, np.float32)
+                            mask[:seg.n_docs] = \
+                                seg.live.astype(np.float32)
+                            cold_l.append(ColdHandle(
+                                seg=seg, seg_index=i, base=base,
+                                live_mask=mask,
+                                live_version=seg.live_version,
+                                bounds=seg.bounds))
+                        base += seg.doc_cap
+                    hot = tuple(hot_l)
+                    cold = tuple(cold_l)
                 view_s = time.perf_counter() - v0
                 self._version += 1
                 snap = SegmentedSnapshot(
@@ -863,7 +964,8 @@ class SegmentedIndex:
                         total_len / total_count if total_count else 1.0),
                     num_docs=jnp.int32(sum(s.doc_cap for s in segments)),
                     version=self._version,
-                    nnz=self.nnz_live)
+                    nnz=self.nnz_live,
+                    hot=hot, cold=cold, tier=self.tier)
                 self.snapshot = snap
                 # only as clean as the generation the snapshot was built from,
                 # and only once it is actually published (ShardIndex.commit
@@ -963,6 +1065,11 @@ class SegmentedIndex:
             self._stats_remove_segment_locked(s)
         if merged is not None:
             self._stats_add_segment_locked(merged)
+        if self.tier is not None:
+            for s in sources:
+                self.tier.discard(s)
+            if merged is not None:
+                self.tier.admit(merged)
         global_metrics.inc("compactions")
 
     def _merge_inline_locked(self, sources: list[Segment],

@@ -48,6 +48,7 @@ from tfidf_tpu.ops.scoring import (QueryBatch, _compile_queries,
                                    bm25_weights, score_coo_compiled,
                                    tfidf_weights)
 from tfidf_tpu.ops.topk import exact_topk, merge_topk, pack_topk
+from tfidf_tpu.utils.metrics import global_metrics
 
 # fixed width buckets so every shard shares one block structure; every
 # width is a multiple of 8 so the terms axis (up to 8-way) can shard the
@@ -186,6 +187,20 @@ def build_mesh_ell(entries_per_shard: list[list],   # list[DocEntry]/shard
                 g_res_tf[s, t, :n] = res_tfs[lo:hi]
                 g_res_term[s, t, :n] = res_terms[lo:hi]
                 g_res_doc[s, t, :n] = res_rows[lo:hi]
+
+    # device-residency accounting (ISSUE 18): the mesh base is always
+    # fully resident (no cold tier on the mesh path), so publish its
+    # HBM footprint on the same gauge family the tiered single-device
+    # engine reports under — capacity dashboards read one bytes number
+    # per node regardless of layout. tf counts twice: the impact plane
+    # is a same-shape f32 copy.
+    dev_bytes = (sum(a.nbytes for a in g_tf) * 2
+                 + sum(a.nbytes for a in g_term)
+                 + sum(a.nbytes for a in g_dl)
+                 + g_bl.nbytes + g_live.nbytes + g_res_tf.nbytes
+                 + g_res_term.nbytes + g_res_doc.nbytes
+                 + g_res_dl.nbytes)
+    global_metrics.set_gauge("mesh_ell_device_bytes", float(dev_bytes))
 
     def put(x, spec):
         return jax.device_put(x, NamedSharding(mesh, spec))

@@ -218,6 +218,34 @@ class Config:
     # streaming rates; the segment backlog then grows unboundedly.
     merge_workers: int = 2
 
+    # --- tiered postings (engine/tiering.py; segments mode only) ---
+    # Device-resident hot set + host/disk cold tier with block-max
+    # skipping: segments beyond the HBM budget are evicted to manifested
+    # spill dirs (mmap-ed back through the storage seam on fault-in) and
+    # most are provably skipped per query batch by per-segment max-score
+    # bounds. Off = every segment stays device-resident (pre-tiering
+    # behavior). Not supported for tfidf_cosine (no sound bound).
+    tier_enabled: bool = False
+    # HBM budget for the hot set, in MiB. The budget is SOFT: in-flight
+    # searches keep their views alive, and the segment being scored is
+    # never evicted from under itself.
+    tier_hot_budget_mb: int = 512
+    # Relative inflation applied to every block-max upper bound so f32
+    # device rounding can never push a true score above the host-side
+    # f64 bound (the skip-soundness margin).
+    tier_skip_margin: float = 1e-4
+    # Upload-ring prefetch depth: how many upcoming cold segments the
+    # searcher streams host->HBM ahead of scoring. 2 = double buffering.
+    tier_ring_depth: int = 2
+    # Cold spill directory. Empty = <index_path>/cold.
+    tier_cold_dir: str = ""
+    # Autopilot tier policy (requires autopilot_enabled): steers the
+    # hot budget toward this tier hit rate — hit rate below target
+    # grows the budget, above shrinks it, clamped to the MiB bounds.
+    tier_hit_target: float = 0.9
+    autopilot_tier_floor_mb: int = 64
+    autopilot_tier_ceiling_mb: int = 4096
+
     # --- storage durability (utils/storage.py) ---
     # fsync-before-ack: an acked upload's raw bytes are fsynced (file +
     # directory, group-committed across concurrent requests) BEFORE the

@@ -300,6 +300,28 @@ def read_bytes(path: str) -> bytes:
     return data
 
 
+def read_memmap(path: str, dtype, shape: tuple):
+    """Map a durable array file read-only through the seam — the cold
+    postings tier (``engine/tiering.py``): the OS page cache IS the
+    host-RAM tier, so a fault-in touches only the pages the device
+    upload actually streams. Integrity is the caller's manifest gate
+    (``verify_manifest`` BEFORE mapping — its ``file_crc`` pass is a
+    read-seam site, so armed bit rot is detected there); a rule that
+    matches here anyway degrades the map to a damaged in-memory copy,
+    keeping the chaos contract (injected rot is observable, never
+    silently bypassed) even for callers that skip the gate."""
+    import numpy as np
+    global_injector.check("storage.read")
+    mm = np.memmap(path, dtype=dtype, mode="r", shape=shape)
+    rule = global_storage.match("read", path)
+    if rule is not None and mm.size:
+        buf = np.array(mm)          # materialize, then flip one byte
+        flat = buf.view(np.uint8).reshape(-1)
+        flat[rule.keep_bytes % flat.shape[0]] ^= 0x5A
+        return buf
+    return mm
+
+
 def fsync_path(path: str) -> None:
     """fsync one file's data. The fsync-EIO injection site."""
     global_injector.check("storage.fsync")

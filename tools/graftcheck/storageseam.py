@@ -13,7 +13,11 @@ INCLUDED — :
 
 - ``open(...)`` with a write/append/update mode literal,
 - ``np.savez`` / ``np.savez_compressed`` (direct or via a handle),
-- ``os.replace`` / ``os.rename``.
+- ``os.replace`` / ``os.rename``,
+- ``np.memmap`` (ISSUE 18): a raw mapping of durable state bypasses
+  the read seam — the disk nemesis cannot flip its bytes and no
+  manifest gate fronts it. The seam's ``read_memmap`` (which checks
+  the injector and honors armed BITROT rules) is the pinned exception.
 
 Reviewed exceptions are pinned in the shared allowlist with a
 justification (the WAL's append-handle discipline — the WAL *is* the
@@ -98,6 +102,9 @@ def analyze(tree: SourceTree, root: str = ".") -> list[Finding]:
             elif dotted in _RENAME_CALLS:
                 op = leaf
             elif leaf in _SAVEZ_LEAVES and dotted.split(".")[0] in (
+                    "np", "numpy"):
+                op = leaf
+            elif leaf == "memmap" and dotted.split(".")[0] in (
                     "np", "numpy"):
                 op = leaf
             if op is None:

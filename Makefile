@@ -166,6 +166,18 @@
 #                     the static wire contract, and the core
 #                     scatter/mutation surface must actually be
 #                     exercised — lockdep-style mutual validation
+#   make devicecheck  the device-hygiene static passes alone
+#                     (tools/graftcheck/devicecheck.py): jit-cache
+#                     discipline, transfer hygiene in the hot serving
+#                     cone, donation audit — fast iteration target;
+#                     `make graftcheck` runs them too
+#   make device-witness  the engine/pipeline/tiering/hybrid suites
+#                     under the runtime device witness (XLA compile
+#                     events + instrumented np fetchers): every
+#                     observed device->host transfer must be explained
+#                     by the static devicecheck cone (named fetch/bulk
+#                     stages or an allowlisted-with-reason site);
+#                     vacuous runs fail (GRAFTCHECK_DEVICE_MIN)
 #   make check        graftcheck + tier-1 in one shot
 
 PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
@@ -175,7 +187,8 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
         chaos-powerloss chaos-upgrade chaos-hybrid chaos-tier scrub \
         faults bench bench-overload bench-routers bench-kernel \
         bench-replay bench-hybrid bench-tier probe-overlap \
-        graftcheck lockdep protocol-witness check trace-demo
+        graftcheck lockdep protocol-witness devicecheck \
+        device-witness check trace-demo
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow'
@@ -213,6 +226,23 @@ protocol-witness:
 	JAX_PLATFORMS=cpu GRAFTCHECK_PROTOCOL=1 python -m pytest \
 	  tests/test_router.py tests/test_partition.py \
 	  tests/test_graftcheck.py tests/test_hybrid.py \
+	  $(PYTEST_FLAGS) -m 'not slow'
+
+devicecheck:
+	python -m tools.graftcheck --only devicecheck
+
+# Suite choice: engine + pipeline + tiering + hybrid are the suites
+# that drive the hot serving cone (searcher dispatch, pipeline
+# dispatch/fetch, tiering upload ring, dense plane) — the paths whose
+# transfers devicecheck reasons about statically. test_devicecheck's
+# own steady-state gate additionally asserts zero post-warmup XLA
+# recompiles; the suite-wide witness checks transfers only (per-test
+# compile churn is expected across a suite).
+device-witness:
+	JAX_PLATFORMS=cpu GRAFTCHECK_DEVICE=1 GRAFTCHECK_DEVICE_MIN=1 \
+	  python -m pytest \
+	  tests/test_engine.py tests/test_pipeline.py \
+	  tests/test_tiering.py tests/test_hybrid.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 trace-demo:

@@ -91,6 +91,58 @@ def log(msg: str) -> None:
 
 
 # --------------------------------------------------------------------------
+# XLA compile accounting over timed windows (ISSUE 19): every validated
+# artifact stamps `xla_compiles_during_measurement` — backend compiles
+# that landed INSIDE a timed window (warmup excluded by construction:
+# the warmup batches run before the window opens). A steady-state
+# serving window with a nonzero count means warmup no longer covers the
+# served shapes — a jit-cache-discipline regression (the compile storm
+# devicecheck guards statically) — and fails the bench loudly rather
+# than publishing a number with compile time buried in it.
+# --------------------------------------------------------------------------
+
+_WINDOW_COMPILES = {"n": 0}
+
+
+def _compile_counter():
+    """Monotonic per-process XLA compile counter, shared with the
+    graftcheck device witness (jax.monitoring has no unregister, so one
+    listener total)."""
+    from tools.graftcheck.device_witness import (compile_count,
+                                                 ensure_compile_listener)
+    ensure_compile_listener()
+    return compile_count
+
+
+class _measured_window:
+    def __init__(self, what: str, steady_state: bool = False) -> None:
+        self.what = what
+        self.steady_state = steady_state
+
+    def __enter__(self) -> "_measured_window":
+        self._count = _compile_counter()
+        self._before = self._count()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if exc[0] is not None:
+            return
+        delta = self._count() - self._before
+        _WINDOW_COMPILES["n"] += delta
+        if delta:
+            log(f"[compile] {delta} XLA compile(s) inside timed window "
+                f"{self.what!r}")
+        if self.steady_state and delta:
+            print(f"BENCH SELF-VALIDATION FAILED: {delta} XLA "
+                  f"compile(s) inside steady-state serving window "
+                  f"{self.what!r} — warmup no longer covers the served "
+                  f"shapes (jit-cache discipline regression; run "
+                  f"python -m tools.graftcheck --only devicecheck)",
+                  file=sys.stderr)
+            sys.exit(1)
+
+
+# --------------------------------------------------------------------------
 # corpus synthesis
 # --------------------------------------------------------------------------
 
@@ -184,9 +236,10 @@ def bench_north_star(rng, corpus=None) -> dict:
     # ONE call over NS_BATCHES chunks: the searcher pipelines chunk i+1's
     # device program under chunk i's fetch + hit assembly
     timed = queries[2 * NS_BATCH:(NS_BATCHES + 2) * NS_BATCH]
-    t0 = time.perf_counter()
-    engine.search_batch(timed, k=TOP_K)
-    qps = len(timed) / (time.perf_counter() - t0)
+    with _measured_window("ns-serving", steady_state=True):
+        t0 = time.perf_counter()
+        engine.search_batch(timed, k=TOP_K)
+        qps = len(timed) / (time.perf_counter() - t0)
     log(f"[ns] {len(timed)} queries -> {qps:.1f} q/s "
         f"(batch={NS_BATCH}, pipelined)")
 
@@ -363,9 +416,10 @@ def bench_config1(rng) -> dict:
     engine.search_batch(queries[:C1_BATCH], k=TOP_K)
     engine.search_batch(queries[C1_BATCH:2 * C1_BATCH], k=TOP_K)
     timed = queries[2 * C1_BATCH:(C1_BATCHES + 2) * C1_BATCH]
-    t0 = time.perf_counter()
-    engine.search_batch(timed, k=TOP_K)
-    qps = len(timed) / (time.perf_counter() - t0)
+    with _measured_window("c1-serving", steady_state=True):
+        t0 = time.perf_counter()
+        engine.search_batch(timed, k=TOP_K)
+        qps = len(timed) / (time.perf_counter() - t0)
     log(f"[c1] {len(timed)} queries -> {qps:.1f} q/s "
         f"(batch={C1_BATCH}, pipelined)")
 
@@ -486,9 +540,10 @@ def bench_mesh(rng) -> dict:
     engine.search_batch(queries[:MESH_BATCH], k=TOP_K)
     engine.search_batch(queries[MESH_BATCH:2 * MESH_BATCH], k=TOP_K)
     timed = queries[2 * MESH_BATCH:(MESH_BATCHES + 2) * MESH_BATCH]
-    t0 = time.perf_counter()
-    engine.search_batch(timed, k=TOP_K)
-    qps = len(timed) / (time.perf_counter() - t0)
+    with _measured_window("mesh-serving", steady_state=True):
+        t0 = time.perf_counter()
+        engine.search_batch(timed, k=TOP_K)
+        qps = len(timed) / (time.perf_counter() - t0)
     log(f"[mesh] {MESH_DOCS} docs on {len(jax.devices())} device(s): "
         f"{qps:.0f} q/s, commit cold {commit_cold_s:.1f}s / steady "
         f"{commit_steady_s*1e3:.0f}ms")
@@ -1889,9 +1944,10 @@ def bench_realistic(rng) -> dict:
     engine.search_batch(queries[:RT_BATCH], k=TOP_K)
     engine.search_batch(queries[RT_BATCH:2 * RT_BATCH], k=TOP_K)
     timed = queries[2 * RT_BATCH:(RT_BATCHES + 2) * RT_BATCH]
-    t0 = time.perf_counter()
-    engine.search_batch(timed, k=TOP_K)
-    qps = len(timed) / (time.perf_counter() - t0)
+    with _measured_window("rt-serving", steady_state=True):
+        t0 = time.perf_counter()
+        engine.search_batch(timed, k=TOP_K)
+        qps = len(timed) / (time.perf_counter() - t0)
     log(f"[rt] {len(timed)} queries -> {qps:.1f} q/s (batch={RT_BATCH})")
 
     # oracle parity from the engine's own analyzer output, through the
@@ -2310,9 +2366,10 @@ def bench_5m_vocab(rng) -> dict:
     engine.search_batch(queries[:C5_BATCH], k=TOP_K)
     engine.search_batch(queries[C5_BATCH:2 * C5_BATCH], k=TOP_K)
     timed = queries[2 * C5_BATCH:4 * C5_BATCH]
-    t0 = time.perf_counter()
-    hits = engine.search_batch(timed, k=TOP_K)
-    qps = len(timed) / (time.perf_counter() - t0)
+    with _measured_window("c5-serving", steady_state=True):
+        t0 = time.perf_counter()
+        hits = engine.search_batch(timed, k=TOP_K)
+        qps = len(timed) / (time.perf_counter() - t0)
     assert any(hits), "5M-vocab index must answer queries"
     log(f"[c5] vocab {vocab_s:.0f}s, ingest {C5_DOCS/ingest_s:.0f} "
         f"docs/s, commit {commit_s:.1f}s, {qps:.0f} q/s")
@@ -3156,7 +3213,15 @@ def _emit_validated(result: dict, headline: dict | None = None) -> None:
     durable file now, never out of the parseable summary: the committed
     ``BENCH_r05.json`` ended up ``"parsed": null`` with the north-star
     numbers truncated away exactly because the one giant detail line
-    went to stdout (see BASELINE.md)."""
+    went to stdout (see BASELINE.md).
+
+    Every artifact also carries ``xla_compiles_during_measurement``: the
+    backend-compile count that landed inside timed ``_measured_window``
+    blocks (warmup excluded). Steady-state serving windows already hard-
+    fail on a nonzero count before reaching here; the stamp makes the
+    property auditable from the artifact alone."""
+    result.setdefault("xla_compiles_during_measurement",
+                      _WINDOW_COMPILES["n"])
     full_line = _validated_json(result, "full result")
     out_path = os.environ.get("BENCH_OUT") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

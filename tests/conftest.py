@@ -109,3 +109,38 @@ def _protocol_witness():
           f"{sum(w.exchanges.values())} exchange(s) across "
           f"{len(rep['paths'])} endpoint(s) observed, all explained "
           f"by the static wire contract")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _device_witness():
+    """GRAFTCHECK_DEVICE=1 runs the selected suite under the device
+    witness (tools/graftcheck/device_witness.py): XLA compile events
+    are counted and the ``np`` binding in every package module records
+    d2h fetches of device arrays — at session end every observed
+    transfer site must be explained by the static devicecheck cone
+    (the named fetch stage or an allowlisted-with-reason site). The
+    per-test compile churn of a suite is expected, so the suite-wide
+    gate checks transfers only; the steady-state zero-recompile gate
+    is the dedicated test in tests/test_devicecheck.py.
+    GRAFTCHECK_DEVICE_MIN floors the observation count (vacuous-pass
+    guard: `make device-witness` sets it, single-suite debugging runs
+    need not). Plain runs are untouched (raw numpy)."""
+    if os.environ.get("GRAFTCHECK_DEVICE") != "1":
+        yield
+        return
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # the witness patches already-imported module namespaces only
+    import tfidf_tpu.engine.pipeline  # noqa: F401
+    import tfidf_tpu.engine.searcher  # noqa: F401
+    import tfidf_tpu.engine.tiering  # noqa: F401
+    from tools.graftcheck.device_witness import DeviceWitness
+    w = DeviceWitness()
+    w.install()
+    yield
+    w.uninstall()
+    w.check(min_observations=int(
+        os.environ.get("GRAFTCHECK_DEVICE_MIN", "0")))
+    print("\n" + w.report() + "\n  all transfer sites statically "
+          "explained")

@@ -1,0 +1,573 @@
+"""Device-hygiene analyzer + runtime device witness (ISSUE 19).
+
+Style of tests/test_graftcheck.py: seeded mini-trees that each new
+static pass MUST catch (a clean verdict is only trustworthy if the
+planted bug trips it), extraction floors against vacuous staleness,
+real-tree gates pinning the reviewed state, and runtime witness tests —
+including the steady-state serving gate: after warmup, a fixed-shape
+search loop must trigger ZERO XLA recompiles, and every device->host
+transfer the witness observes must be explained by the static cone.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+import pytest
+
+from tools.graftcheck import core as gc_core
+from tools.graftcheck import devicecheck
+from tools.graftcheck.core import SourceTree, load_allowlist
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mini_tree(tmp_path, files: dict[str, str]) -> SourceTree:
+    pkg = tmp_path / gc_core.PACKAGE
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if not (p.parent / "__init__.py").exists():
+            (p.parent / "__init__.py").write_text("")
+        p.write_text(src)
+    return SourceTree(str(tmp_path))
+
+
+def _keys(findings) -> set[str]:
+    return {f.key for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded fixtures: each pass must catch its planted bug
+# ---------------------------------------------------------------------------
+
+class TestSeededCacheDiscipline:
+    def test_uncached_jit_creation(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"bad.py": '''
+import jax
+
+def hot_path(xs):
+    f = jax.jit(lambda x: x * 2)      # fresh trace EVERY call
+    return f(xs)
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert "devicecheck:jit-uncached:bad.hot_path" in keys
+
+    def test_memoized_jit_is_clean(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"ok.py": '''
+import jax
+from tfidf_tpu.caps import next_capacity
+
+class Applier:
+    def __init__(self):
+        self._fns = {}
+
+    def apply(self, df, uniq):
+        cap = next_capacity(int(uniq.shape[0]), 256)
+        fn = self._fns.get(cap)
+        if fn is None:
+            fn = jax.jit(lambda d, i: d.at[i].add(1.0))
+            self._fns[cap] = fn
+        return fn(df, uniq)
+''', "caps.py": '''
+def next_capacity(n, minimum):
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert not any(k.startswith("devicecheck:jit-") for k in keys)
+
+    def test_unstable_cache_key(self, tmp_path):
+        # same memo-store shape, but keyed on the RAW corpus size: every
+        # doc count mints a new executable — the compile-storm bug
+        tree = _mini_tree(tmp_path, {"bad.py": '''
+import jax
+
+class Applier:
+    def __init__(self):
+        self._fns = {}
+
+    def apply(self, df, uniq):
+        n = int(uniq.shape[0])            # corpus-dependent, unbucketed
+        fn = self._fns.get(n)
+        if fn is None:
+            fn = jax.jit(lambda d, i: d.at[i].add(1.0))
+            self._fns[n] = fn
+        return fn(df, uniq)
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert "devicecheck:jit-unstable-key:bad.Applier.apply" in keys
+
+    def test_corpus_value_into_static_arg(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"ops.py": '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk(scores, *, k):
+    return jax.lax.top_k(scores, k)
+''', "bad.py": '''
+from tfidf_tpu.ops import topk
+
+class Searcher:
+    def dispatch(self, scores, snap):
+        return topk(scores, k=snap.n_docs)   # recompiles as corpus grows
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert ("devicecheck:jit-corpus-static:bad.Searcher.dispatch:"
+                "topk.k" in keys)
+
+    def test_min_bounded_static_arg_is_clean(self, tmp_path):
+        # min(k, corpus) is capacity-class: at most k distinct values,
+        # stabilizing once the corpus outgrows k — the established idiom
+        tree = _mini_tree(tmp_path, {"ops.py": '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk(scores, *, k):
+    return jax.lax.top_k(scores, k)
+''', "ok.py": '''
+from tfidf_tpu.ops import topk
+
+class Searcher:
+    def dispatch(self, scores, snap, k):
+        kk = min(k, snap.n_docs)
+        return topk(scores, k=kk)
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert not any("jit-corpus-static" in k for k in keys)
+
+    def test_factory_return_is_a_seam(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"ok.py": '''
+import jax
+
+def make_search(mesh, k):
+    def step(q, emb):
+        return jax.lax.top_k(q @ emb.T, k)
+    return jax.jit(step)
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert not any(k.startswith("devicecheck:jit-uncached")
+                       for k in keys)
+
+
+class TestSeededTransferHygiene:
+    # the cone-root machinery is driven with a synthetic root list so
+    # the fixture is self-contained (the real CONE_ROOTS name real
+    # modules, which a mini-tree does not carry)
+
+    def _analyze(self, tree, roots):
+        dc = devicecheck._DeviceCheck(tree, cone_roots=roots)
+        dc.check_transfers()
+        return _keys(dc.findings)
+
+    def test_item_in_dispatch_cone(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"srv.py": '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def score(q):
+    return q * 2.0
+
+class Searcher:
+    def _dispatch_chunk(self, q, k):
+        scores = score(q)
+        best = scores.max()
+        if float(best) <= 0.0:            # blocking d2h mid-dispatch
+            return None
+        n = scores.shape[0]
+        lead = scores[0].item()           # and another one
+        host = np.asarray(scores)         # and a full fetch
+        return host, lead, n
+'''})
+        keys = self._analyze(tree, ("srv.Searcher._dispatch_chunk",))
+        qual = "srv.Searcher._dispatch_chunk"
+        assert f"devicecheck:transfer:{qual}:float" in keys
+        assert f"devicecheck:transfer:{qual}:item" in keys
+        assert f"devicecheck:transfer:{qual}:asarray" in keys
+
+    def test_annotated_device_attr_sync(self, tmp_path):
+        # the shape of the real finding this PR fixed: float() on a
+        # dataclass field annotated jax.Array, reached via the
+        # annotated snap parameter
+        tree = _mini_tree(tmp_path, {"snapmod.py": '''
+import jax
+from dataclasses import dataclass
+
+@dataclass
+class Snap:
+    n_docs: jax.Array
+    version: int = 0
+''', "srv.py": '''
+from tfidf_tpu.snapmod import Snap
+
+class Searcher:
+    def _dispatch_chunk(self, snap: Snap, k):
+        n = float(snap.n_docs)            # per-dispatch device sync
+        return n * k
+'''})
+        keys = self._analyze(tree, ("srv.Searcher._dispatch_chunk",))
+        assert ("devicecheck:transfer:srv.Searcher._dispatch_chunk:"
+                "float" in keys)
+
+    def test_fetch_stage_is_exempt(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"ops/topk.py": '''
+import numpy as np
+import jax
+
+@jax.jit
+def packed(q):
+    return q
+
+def fetch_packed(arr):
+    dev = packed(arr)
+    return np.asarray(dev)                # THE one sanctioned d2h
+'''})
+        keys = self._analyze(tree, ("ops.topk.fetch_packed",))
+        assert not any("transfer" in k for k in keys)
+
+    def test_missing_cone_root_is_a_finding(self, tmp_path):
+        # module exists but the named method is gone: a rename must
+        # update CONE_ROOTS, not silently shrink the cone
+        tree = _mini_tree(tmp_path, {"srv.py": '''
+class Searcher:
+    def renamed(self):
+        pass
+'''})
+        keys = self._analyze(tree, ("srv.Searcher._dispatch_chunk",))
+        assert ("devicecheck:cone-root-missing:"
+                "srv.Searcher._dispatch_chunk" in keys)
+
+
+class TestSeededDonation:
+    def test_missing_donation_candidate(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"df.py": '''
+import jax
+
+class Applier:
+    def __init__(self):
+        self._fns = {}
+
+    def apply(self, df):
+        fn = self._fns.get(df.shape[0])
+        if fn is None:
+            fn = jax.jit(lambda d: d + 1.0)   # no donate_argnums
+            self._fns[df.shape[0]] = fn
+        return fn(df)
+
+class Index:
+    def __init__(self):
+        self._df = None
+        self._app = Applier()
+
+    def commit(self):
+        new = self._app.apply(self._df)   # self._df dead after this…
+        self._df = new                    # …rebound here
+        return new
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert "devicecheck:donation:df.Index.commit:apply" in keys
+
+    def test_donated_seam_is_clean(self, tmp_path):
+        tree = _mini_tree(tmp_path, {"df.py": '''
+import jax
+
+class Applier:
+    def __init__(self):
+        self._fns = {}
+
+    def apply(self, df):
+        fn = self._fns.get(df.shape[0])
+        if fn is None:
+            fn = jax.jit(lambda d: d + 1.0, donate_argnums=0)
+            self._fns[df.shape[0]] = fn
+        return fn(df)
+
+class Index:
+    def __init__(self):
+        self._df = None
+        self._app = Applier()
+
+    def commit(self):
+        new = self._app.apply(self._df)
+        self._df = new
+        return new
+'''})
+        keys = _keys(devicecheck.analyze(tree))
+        assert not any(k.startswith("devicecheck:donation") for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# 2. extraction floors: clean verdicts must not go vacuously stale
+# ---------------------------------------------------------------------------
+
+class TestExtractionFloors:
+    @pytest.fixture(scope="class")
+    def tree(self):
+        return SourceTree(REPO_ROOT)
+
+    def test_jit_roots_discovered(self, tree):
+        roots = devicecheck.jit_roots(tree)
+        # 31 at pin time (19 jit + 12 shard_map): dense plane, ELL
+        # kernels, topk family, dfdelta, mesh factories
+        assert len(roots) >= 25
+        kinds = {r.kind for r in roots}
+        assert "shard_map" in kinds and "jit" in kinds
+
+    def test_module_entries_and_static_names(self, tree):
+        roots = devicecheck.jit_roots(tree)
+        entries = {f"{r.mi.name}.{r.bound}" for r in roots if r.bound}
+        assert len(entries) >= 8
+        assert "ops.topk.packed_topk_chunked" in entries
+        by_name = {f"{r.mi.name}.{r.bound}": r for r in roots if r.bound}
+        # static_argnames extraction: the (capacity, k, chunk) pattern
+        assert "k" in by_name["ops.topk.packed_topk_chunked"].static_names
+        assert "chunk" in by_name["ops.dense.packed_dense_topk"] \
+            .static_names
+
+    def test_scoped_creations_classified(self, tree):
+        # the per-capacity dfdelta cache and the mesh factories are
+        # function-scoped jit creations — the seam classifier must see
+        # them (and, per the real-tree gate, accept every one)
+        roots = devicecheck.jit_roots(tree)
+        scoped = [r for r in roots if r.scope is not None]
+        assert len(scoped) >= 10
+        quals = {r.scope.qual for r in scoped}
+        assert "ops.dfdelta.DfDeltaApplier.apply" in quals
+
+    def test_cone_covers_the_serving_paths(self, tree):
+        dc = devicecheck._DeviceCheck(tree)
+        cone = dc.cone()
+        assert not any(f.key.startswith("devicecheck:cone-root-missing")
+                       for f in dc.findings), [f.key for f in dc.findings]
+        assert len(cone) >= 40     # 91 at pin time: closed call graph
+        assert "engine.searcher.Searcher._dispatch_tiered" in cone
+        assert "engine.tiering.TierManager._build_device" in cone
+
+    def test_device_attr_annotations_extracted(self, tree):
+        dc = devicecheck._DeviceCheck(tree)
+        # the annotation-driven taint that caught the fixed finding
+        assert "n_docs" in dc._device_attrs[
+            "engine.segments.SegmentedSnapshot"]
+        assert "df" in dc._device_attrs["engine.index.Snapshot"]
+
+
+# ---------------------------------------------------------------------------
+# 3. real tree: the reviewed state, pinned
+# ---------------------------------------------------------------------------
+
+class TestRealTree:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return devicecheck.analyze(SourceTree(REPO_ROOT))
+
+    def test_no_unpinned_findings(self, findings):
+        allowlist = load_allowlist()
+        new = [f for f in findings if f.key not in allowlist]
+        assert not new, "unreviewed device-hygiene finding(s):\n" + \
+            "\n".join(f.render() for f in new)
+
+    def test_fixed_dispatch_sync_stays_fixed(self, findings):
+        """Regression pin for the real finding this PR fixed: the tiered
+        dispatch read float(snap.n_docs)/float(snap.avgdl) — a blocking
+        d2h sync per dispatched chunk — now served by the host mirrors
+        stamped at commit (SegmentedSnapshot.n_docs_f/avgdl_f)."""
+        assert ("devicecheck:transfer:engine.searcher.Searcher."
+                "_dispatch_tiered:float" not in _keys(findings))
+
+    def test_host_mirrors_match_device_scalars(self):
+        """The fix is only sound if the mirrors equal the device
+        scalars they replace."""
+        import numpy as np
+
+        from tfidf_tpu.engine.segments import SegmentedIndex
+        from tfidf_tpu.models.bm25 import BM25Model
+
+        idx = SegmentedIndex(BM25Model())
+        rng = np.random.default_rng(0)
+        for d in range(20):
+            ids = rng.choice(100, size=5, replace=False).astype(np.int64)
+            idx.add_document(f"d{d}", {int(t): 1 + int(t) % 3
+                                       for t in ids})
+        idx.commit(vocab_cap=128)
+        snap = idx.snapshot
+        assert snap.n_docs_f == float(np.asarray(snap.n_docs))
+        assert snap.avgdl_f == pytest.approx(
+            float(np.asarray(snap.avgdl)))
+
+    def test_tiered_dispatch_has_reviewed_asarray_pin(self, findings):
+        """The tiered host-merge d2h is intentional (the method IS its
+        own fetch stage) — it must stay VISIBLE as an allowlisted
+        finding, not vanish from the analyzer."""
+        key = ("devicecheck:transfer:engine.searcher.Searcher."
+               "_dispatch_tiered:asarray")
+        assert key in _keys(findings)
+        assert key in load_allowlist()
+
+    def test_donation_pins_carry_reasons(self, findings):
+        allowlist = load_allowlist()
+        donation = [f.key for f in findings
+                    if f.key.startswith("devicecheck:donation:")]
+        assert donation, "donation audit found nothing on the real " \
+            "tree — the committed-df seams should be candidates"
+        for k in donation:
+            assert len(allowlist.get(k, "")) > 40, \
+                f"donation finding {k} lacks a reviewed reason"
+
+
+# ---------------------------------------------------------------------------
+# 4. runtime device witness
+# ---------------------------------------------------------------------------
+
+_OWNS_NS = os.environ.get("GRAFTCHECK_DEVICE") == "1"
+
+
+def _fixture_module(name: str, source: str):
+    """A throwaway tfidf_tpu submodule the witness will instrument."""
+    import numpy as np
+    mod = types.ModuleType(f"{gc_core.PACKAGE}.{name}")
+    mod.__dict__["np"] = np
+    exec(compile(source, f"<{name}>", "exec"), mod.__dict__)
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+@pytest.mark.skipif(_OWNS_NS, reason="session device witness owns the "
+                    "package namespaces; nested install would fight it")
+class TestDeviceWitness:
+    def test_unexplained_transfer_fails(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tools.graftcheck.device_witness import DeviceWitness
+        mod = _fixture_module("zz_dw_fixture", """
+def leaky_dispatch(x):
+    return np.asarray(x)          # d2h outside any explained site
+""")
+        try:
+            w = DeviceWitness(explained=set()).install()
+            try:
+                mod.leaky_dispatch(jnp.ones(4))
+            finally:
+                w.uninstall()
+            assert w.observed, "proxy recorded nothing"
+            with pytest.raises(AssertionError,
+                               match="did not explain"):
+                w.check()
+        finally:
+            sys.modules.pop(mod.__name__, None)
+
+    def test_explained_transfer_passes(self, tmp_path):
+        import jax.numpy as jnp
+
+        from tools.graftcheck.device_witness import DeviceWitness
+        mod = _fixture_module("zz_dw_fixture2", """
+def fetch_stage(x):
+    return np.asarray(x)
+""")
+        try:
+            w = DeviceWitness(
+                explained={("zz_dw_fixture2", "fetch_stage")}).install()
+            try:
+                mod.fetch_stage(jnp.ones(4))
+            finally:
+                w.uninstall()
+            w.check(min_observations=1)   # observed AND explained
+        finally:
+            sys.modules.pop(mod.__name__, None)
+
+    def test_host_arrays_not_recorded(self, tmp_path):
+        import numpy as np
+
+        from tools.graftcheck.device_witness import DeviceWitness
+        mod = _fixture_module("zz_dw_fixture3", """
+def host_only(x):
+    return np.asarray(x)
+""")
+        try:
+            w = DeviceWitness(explained=set()).install()
+            try:
+                mod.host_only(np.ones(4))
+            finally:
+                w.uninstall()
+            assert not w.observed
+            w.check()
+        finally:
+            sys.modules.pop(mod.__name__, None)
+
+    def test_vacuous_run_fails_floor(self):
+        from tools.graftcheck.device_witness import DeviceWitness
+        w = DeviceWitness(explained=set()).install()
+        w.uninstall()
+        with pytest.raises(AssertionError, match="vacuous"):
+            w.check(min_observations=1)
+
+    def test_post_warmup_recompile_detected(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tools.graftcheck.device_witness import DeviceWitness
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        w = DeviceWitness(explained=set()).install()
+        try:
+            f(jnp.ones(8))                   # warmup compile
+            w.end_warmup()
+            f(jnp.ones(8))                   # cache hit: no event
+            w.check(max_post_warmup_compiles=0)
+            f(jnp.ones(16))                  # NEW shape: recompile
+            with pytest.raises(AssertionError, match="post-warmup"):
+                w.check(max_post_warmup_compiles=0)
+        finally:
+            w.uninstall()
+
+
+class TestSteadyStateServing:
+    def test_zero_recompiles_after_warmup(self, tmp_path):
+        """The PAPER §7 claim the analyzer exists to guard, measured:
+        after two warmup batches (compile + u_cap ratchet), a
+        steady-state stream of same-bucket batches must re-enter XLA
+        compilation exactly zero times."""
+        import numpy as np
+
+        from tfidf_tpu.engine.engine import Engine
+        from tfidf_tpu.utils.config import Config
+        from tools.graftcheck.device_witness import (
+            DeviceWitness, compile_count, ensure_compile_listener)
+
+        ensure_compile_listener()
+        cfg = Config(documents_path=str(tmp_path / "docs"),
+                     index_path=str(tmp_path / "index"),
+                     min_nnz_capacity=256, min_doc_capacity=64,
+                     min_vocab_capacity=64)
+        eng = Engine(cfg)
+        rng = np.random.default_rng(7)
+        vocab = [f"t{i}" for i in range(50)]
+        for d in range(48):
+            words = rng.choice(vocab, size=12)
+            eng.ingest_text(f"doc{d}", " ".join(words))
+        eng.commit()
+
+        def batch(seed):
+            r = np.random.default_rng(seed)
+            return [" ".join(r.choice(vocab, size=3, replace=False))
+                    for _ in range(8)]
+
+        w = DeviceWitness(explained=set())
+        # no install(): compile counting needs no namespace swap, and
+        # the session witness may own the proxies already
+        eng.search_batch(batch(0), k=5)      # warmup: compiles
+        eng.search_batch(batch(1), k=5)      # warmup: ratchets floors
+        w.end_warmup()
+        for i in range(2, 8):
+            eng.search_batch(batch(i), k=5)  # steady state
+        assert w.post_warmup_compiles() == 0, (
+            f"{w.post_warmup_compiles()} recompile(s) in steady-state "
+            f"serving (total this process: {compile_count()})")

@@ -464,8 +464,11 @@ class Autopilot:
                     write=lambda v: b.set_linger_bounds(hi_s=v / 1e3)))
         # the tier-budget controller only exists where a tiered
         # segmented index is serving (engine.tier) — it steers this
-        # node's hot-set HBM budget toward the tier hit-rate target
-        tier = getattr(node.engine, "tier", None)
+        # node's hot-set HBM budget toward the tier hit-rate target.
+        # Not every autopilot host HAS an engine: the stateless router
+        # tier runs an autopilot too (hedge/linger knobs) and serves
+        # no index at all
+        tier = getattr(getattr(node, "engine", None), "tier", None)
         if tier is not None:
             self.controllers.append(TierBudgetController(
                 cfg,

@@ -444,8 +444,10 @@ class Searcher(QueryVectorizerMixin):
             # qc is all-zero -> bound exactly 0 -> always skippable
             uniq_terms = np.asarray(qb.uniq[:U]).astype(np.int64)
             df_u = snap.df_host[uniq_terms].astype(np.float64)
-            n_docs_f = float(snap.n_docs)
-            avgdl_f = float(snap.avgdl)
+            # host mirrors, stamped at commit: reading the device
+            # scalars here was a blocking d2h sync per dispatched chunk
+            n_docs_f = snap.n_docs_f
+            avgdl_f = snap.avgdl_f
             for h in handles:
                 ub_of[id(h)] = query_upper_bounds(
                     h.bounds, uniq_terms, qc, df_u, n_docs_f, avgdl_f,

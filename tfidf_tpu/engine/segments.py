@@ -175,6 +175,22 @@ class SegmentedSnapshot:
     hot: tuple = ()
     cold: tuple = ()
     tier: object | None = None
+    # host mirrors of the n_docs/avgdl device scalars: the tiered
+    # dispatch's block-max bound evaluation is host-side arithmetic, and
+    # reading the device scalars there cost a blocking d2h sync per
+    # dispatched chunk (devicecheck:transfer finding, ISSUE 19) — the
+    # builder has both values on the host anyway
+    n_docs_f: float | None = None
+    avgdl_f: float | None = None
+
+    def __post_init__(self) -> None:
+        # fallback for construction sites that predate the mirrors: one
+        # sync at COMMIT time (not in the serving cone) keeps bounds
+        # sound either way
+        if self.n_docs_f is None:
+            self.n_docs_f = float(self.n_docs)
+        if self.avgdl_f is None:
+            self.avgdl_f = float(self.avgdl)
 
     # searcher compatibility surface
     @property
@@ -965,7 +981,10 @@ class SegmentedIndex:
                     num_docs=jnp.int32(sum(s.doc_cap for s in segments)),
                     version=self._version,
                     nnz=self.nnz_live,
-                    hot=hot, cold=cold, tier=self.tier)
+                    hot=hot, cold=cold, tier=self.tier,
+                    n_docs_f=float(total_count),
+                    avgdl_f=float(total_len / total_count
+                                  if total_count else 1.0))
                 self.snapshot = snap
                 # only as clean as the generation the snapshot was built from,
                 # and only once it is actually published (ShardIndex.commit

@@ -25,8 +25,9 @@ import sys
 from tools.graftcheck.core import (BASELINE_PATH, load_allowlist,
                                    load_baseline, run_analyzers, triage)
 
-ANALYZERS = ("lockgraph", "jitpurity", "registry_drift", "resilience",
-             "wallclock", "protocol", "deadsymbols", "storageseam")
+ANALYZERS = ("lockgraph", "jitpurity", "devicecheck", "registry_drift",
+             "resilience", "wallclock", "protocol", "deadsymbols",
+             "storageseam")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -540,13 +540,14 @@ def run_analyzers(root: str, analyzers: list[str] | None = None
                   ) -> list[Finding]:
     """Run the requested analyzers (default: all) over the package at
     ``root``; returns RAW findings (baseline/allowlist not applied)."""
-    from tools.graftcheck import (deadsymbols, jitpurity, lockgraph,
-                                  protocol, registry_drift, resilience,
-                                  storageseam, wallclock)
+    from tools.graftcheck import (deadsymbols, devicecheck, jitpurity,
+                                  lockgraph, protocol, registry_drift,
+                                  resilience, storageseam, wallclock)
     tree = SourceTree(root)
     passes = {
         "lockgraph": lockgraph.analyze,
         "jitpurity": jitpurity.analyze,
+        "devicecheck": devicecheck.analyze,
         "registry_drift": lambda t: registry_drift.analyze(t, root),
         "resilience": resilience.analyze,
         "wallclock": wallclock.analyze,

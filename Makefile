@@ -139,6 +139,24 @@
 #                     the host replica, and every search stays in
 #                     exact untiered-oracle parity
 #                     (tests/test_tiering.py -m slow)
+#   make bench-compute  r20 degraded-mode bench: the same measured
+#                     search loop on the healthy device path and on
+#                     the host-fallback path (device forced sick via
+#                     the nemesis), q/s + p50/p99 side by side with
+#                     in-run bit-parity gating and the steady-state
+#                     zero-recompile witness on the healthy leg;
+#                     writes BENCH_r13.json
+#   make chaos-compute  slow compute-plane chaos job: zipfian load
+#                     over a subprocess fleet while the device nemesis
+#                     OOMs one worker's every dispatch (host-fallback
+#                     degraded serving, honestly stamped
+#                     X-Compute-Degraded), slow-wedges another, and
+#                     poisons a query's rows on two replicas — every
+#                     200 exact-parity-or-honestly-stamped, zero
+#                     acked-write loss, the poison fingerprint
+#                     quarantined (front-door 422) after exactly two
+#                     distinct replica verdicts, full recovery after
+#                     heal (tests/test_compute_chaos.py -m slow)
 
 #   make trace-demo   zero-to-aha for the tracing layer: spin a small
 #                     in-process cluster, kill a worker mid-request,
@@ -184,9 +202,11 @@ PYTEST_FLAGS := -q --continue-on-collection-errors -p no:cacheprovider
 
 .PHONY: test chaos chaos-coord chaos-replica chaos-rebalance \
         chaos-overload chaos-partition chaos-autopilot chaos-router \
-        chaos-powerloss chaos-upgrade chaos-hybrid chaos-tier scrub \
+        chaos-powerloss chaos-upgrade chaos-hybrid chaos-tier \
+        chaos-compute scrub \
         faults bench bench-overload bench-routers bench-kernel \
-        bench-replay bench-hybrid bench-tier probe-overlap \
+        bench-replay bench-hybrid bench-tier bench-compute \
+        probe-overlap \
         graftcheck lockdep protocol-witness devicecheck \
         device-witness check trace-demo
 
@@ -212,7 +232,7 @@ lockdep:
 	  tests/test_router.py tests/test_storage.py \
 	  tests/test_commit_stats.py tests/test_upgrade.py \
 	  tests/test_graftcheck.py tests/test_hybrid.py \
-	  tests/test_tiering.py \
+	  tests/test_tiering.py tests/test_compute_chaos.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 # Suite choice: test_router drives the stateless-router tier (reads,
@@ -243,6 +263,7 @@ device-witness:
 	  python -m pytest \
 	  tests/test_engine.py tests/test_pipeline.py \
 	  tests/test_tiering.py tests/test_hybrid.py \
+	  tests/test_compute_chaos.py \
 	  $(PYTEST_FLAGS) -m 'not slow'
 
 trace-demo:
@@ -286,6 +307,9 @@ chaos-hybrid:
 chaos-tier:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tiering.py $(PYTEST_FLAGS) -m slow
 
+chaos-compute:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_compute_chaos.py $(PYTEST_FLAGS) -m slow
+
 scrub:
 	python -m tfidf_tpu scrub
 
@@ -315,3 +339,6 @@ bench-hybrid:
 
 bench-tier:
 	BENCH_OUT=BENCH_r12.json python bench.py --tier
+
+bench-compute:
+	BENCH_OUT=BENCH_r13.json python bench.py --compute

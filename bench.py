@@ -3180,6 +3180,175 @@ def tier_main() -> None:
     _emit_validated(result, headline)
 
 
+# --------------------------------------------------------------------------
+# r20: degraded-mode serving — the host-fallback scorer vs the healthy
+# device path on the SAME engine, corpus, and query stream. The number
+# that matters operationally is the honest cost of X-Compute-Degraded:
+# how much q/s (and p99) a worker gives up when its device goes sick
+# and its share rides the numpy mirror. Bit-parity is gated IN-RUN
+# (the fallback's contract is "exact, just slower") before any timing
+# is trusted.
+# --------------------------------------------------------------------------
+
+CP_DOCS = int(os.environ.get("COMPUTE_DOCS", 50_000))
+CP_VOCAB = 30_000
+CP_AVG_LEN = 60
+CP_QUERIES = 256
+CP_QBATCH = 32
+CP_K = 10
+CP_REPS = 3
+
+
+def bench_compute(rng) -> dict:
+    import shutil
+    import tempfile
+
+    import jax
+
+    from tfidf_tpu.engine import Engine
+    from tfidf_tpu.engine.compute_health import HostFallbackScorer
+    from tfidf_tpu.utils.config import Config
+
+    work = tempfile.mkdtemp(prefix="bench_compute_")
+    # use_pallas=False: the fallback is pinned bit-equal to the XLA
+    # reference program (the kernels are tolerance-gated against the
+    # same reference in their own bench) — the parity gate below is
+    # only meaningful against that path. Probe interval effectively
+    # infinite so the degraded leg never sneaks a device probe into a
+    # timed window.
+    cfg = Config(index_path=os.path.join(work, "index"),
+                 query_batch=CP_QBATCH, embedding_enabled=False,
+                 use_pallas=False, compute_sick_after=2,
+                 compute_probe_interval_s=1e9)
+    engine = Engine(cfg)
+    try:
+        t0 = time.perf_counter()
+        for i in range(CP_VOCAB):
+            engine.vocab.add(f"t{i}")
+        offsets, ids, tfs, lengths = make_doc_arrays(
+            rng, CP_DOCS, CP_VOCAB, CP_AVG_LEN)
+        add = engine.index.add_document_arrays
+        for i in range(CP_DOCS):
+            lo, hi = offsets[i], offsets[i + 1]
+            add(f"d{i}", ids[lo:hi], tfs[lo:hi], float(lengths[i]))
+        engine.commit()
+        log(f"[cp] ingest+commit {CP_DOCS} docs in "
+            f"{time.perf_counter() - t0:.1f}s")
+        queries = make_queries(rng, CP_VOCAB, CP_QUERIES)
+        batches = [queries[i:i + CP_QBATCH]
+                   for i in range(0, CP_QUERIES, CP_QBATCH)]
+
+        # ---- in-run bit-parity gate: device vs host mirror, before
+        # any timing is trusted ----
+        fb = HostFallbackScorer(engine.searcher)
+        d_vals, d_ids, _k, d_names = engine.searcher.search_arrays(
+            batches[0], k=CP_K)
+        h_vals, h_ids, _k2, h_names = fb.search_arrays(
+            batches[0], k=CP_K)
+        if (np.asarray(d_vals).tobytes() != h_vals.tobytes()
+                or not np.array_equal(np.asarray(d_ids), h_ids)
+                or list(d_names) != list(h_names)):
+            print("BENCH SELF-VALIDATION FAILED: host fallback is not "
+                  "bit-identical to the device path — the degraded "
+                  "numbers below would be measuring a DIFFERENT "
+                  "function", file=sys.stderr)
+            sys.exit(1)
+        log("[cp] parity gate: host fallback bit-identical to the "
+            "device path")
+
+        def timed_pass(tag: str) -> tuple[float, list]:
+            lats = []
+            with _measured_window(tag, steady_state=True):
+                t0 = time.perf_counter()
+                for _ in range(CP_REPS):
+                    for b in batches:
+                        b0 = time.perf_counter()
+                        engine.search_batch(b, k=CP_K)
+                        lats.append(time.perf_counter() - b0)
+                total = time.perf_counter() - t0
+            return CP_REPS * CP_QUERIES / total, lats
+
+        def p(lats, q):
+            return round(float(np.percentile(
+                np.asarray(lats) * 1e3, q)), 3)
+
+        # ---- healthy leg (device path), warmup excluded ----
+        for b in batches[:2]:
+            engine.search_batch(b, k=CP_K)
+        assert not engine.pop_fallback_served()
+        healthy_qps, h_lats = timed_pass("compute.healthy")
+
+        # ---- degraded leg: force the health machine sick — every
+        # request rides the host mirror, exactly what a worker serves
+        # after its device OOMs to death ----
+        for _ in range(cfg.compute_sick_after):
+            engine.compute.note_fault("transient")
+        engine.pop_fallback_served()
+        for b in batches[:2]:          # mirror build + cache warm
+            engine.search_batch(b, k=CP_K)
+        if not engine.pop_fallback_served():
+            print("BENCH SELF-VALIDATION FAILED: degraded leg is NOT "
+                  "serving from the host fallback", file=sys.stderr)
+            sys.exit(1)
+        degraded_qps, d_lats = timed_pass("compute.degraded")
+        if not engine.pop_fallback_served():
+            print("BENCH SELF-VALIDATION FAILED: fallback flag vanished "
+                  "mid-measurement (device probe leaked into the timed "
+                  "window)", file=sys.stderr)
+            sys.exit(1)
+
+        return {
+            "docs": CP_DOCS, "vocab": CP_VOCAB,
+            "queries": CP_QUERIES, "query_batch": CP_QBATCH,
+            "k": CP_K, "reps": CP_REPS,
+            "healthy_qps": round(healthy_qps, 1),
+            "healthy_p50_ms": p(h_lats, 50),
+            "healthy_p99_ms": p(h_lats, 99),
+            "degraded_qps": round(degraded_qps, 1),
+            "degraded_p50_ms": p(d_lats, 50),
+            "degraded_p99_ms": p(d_lats, 99),
+            "degraded_slowdown_x": round(
+                healthy_qps / max(degraded_qps, 1e-9), 2),
+            "parity": "bit-exact",
+            "backend": jax.devices()[0].platform,
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def compute_main() -> None:
+    """Standalone entry (``python bench.py --compute``;
+    ``make bench-compute`` sets ``BENCH_OUT=BENCH_r13.json``). The
+    headline is the host-fallback (degraded) q/s beside the healthy
+    device-path q/s on the same engine and query stream;
+    ``vs_baseline`` is degraded over healthy — the fraction of
+    throughput a sick-device worker retains while serving honestly
+    stamped X-Compute-Degraded replies. Backend stamped honestly per
+    the r09 precedent: a CPU run says ``cpu``."""
+    os.environ.setdefault("BENCH_OUT", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r13.json"))
+    rng = np.random.default_rng(SEED)
+    cp = bench_compute(rng)
+    result = {
+        "metric": "host_fallback_degraded_qps_50k_docs",
+        "value": cp["degraded_qps"],
+        "unit": "queries/sec",
+        "vs_baseline": round(cp["degraded_qps"]
+                             / max(cp["healthy_qps"], 1e-9), 3),
+        "extra": cp,
+    }
+    headline = {
+        "healthy_qps": cp["healthy_qps"],
+        "degraded_qps": cp["degraded_qps"],
+        "degraded_slowdown_x": cp["degraded_slowdown_x"],
+        "healthy_p99_ms": cp["healthy_p99_ms"],
+        "degraded_p99_ms": cp["degraded_p99_ms"],
+        "parity": cp["parity"],
+        "backend": cp["backend"],
+    }
+    _emit_validated(result, headline)
+
+
 def _validated_json(obj: dict, what: str) -> str:
     """Serialize + re-parse + key-check; exit 1 LOUDLY on any problem
     instead of leaving a broken artifact behind (PR-2 self-validation)."""
@@ -3330,5 +3499,7 @@ if __name__ == "__main__":
         hybrid_main()
     elif "--tier" in sys.argv:
         tier_main()
+    elif "--compute" in sys.argv:
+        compute_main()
     else:
         main()

@@ -68,6 +68,15 @@ def test_k8s_manifest_structure():
     vols = {v["name"] for v in pod["volumes"]}
     assert vols == {"documents", "index"}
 
+    # readiness is COMPUTE readiness (ISSUE 20): the probe must hit
+    # /api/ready — a sick device with no host fallback takes the pod
+    # out of Service endpoints; degraded (host-mirror) serving and a
+    # merely-sick-but-falling-back device stay Ready. Any drift back
+    # to /api/status would silently keep unqueryable pods in rotation.
+    probe = pod["containers"][0]["readinessProbe"]["httpGet"]
+    assert probe["path"] == "/api/ready"
+    assert probe["port"] == 8085
+
 
 def test_k8s_autopilot_enabled_with_clamps():
     """The manifest ships the SLO autopilot, not hand-tuned constants:

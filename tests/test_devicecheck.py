@@ -331,7 +331,7 @@ class TestExtractionFloors:
         by_name = {f"{r.mi.name}.{r.bound}": r for r in roots if r.bound}
         # static_argnames extraction: the (capacity, k, chunk) pattern
         assert "k" in by_name["ops.topk.packed_topk_chunked"].static_names
-        assert "chunk" in by_name["ops.dense.packed_dense_topk"] \
+        assert "chunk" in by_name["ops.dense._packed_dense_topk_jit"] \
             .static_names
 
     def test_scoped_creations_classified(self, tree):

@@ -1083,8 +1083,8 @@ class TestProtocolRealTree:
 
     def test_status_contract_pinned(self, tree):
         c = protocol.build_contract(REPO_ROOT, tree)
-        assert c.statuses == {200, 400, 403, 404, 409, 415, 421, 426,
-                              429, 500, 503, 504, 507}
+        assert c.statuses == {200, 400, 403, 404, 409, 415, 421, 422,
+                              426, 429, 500, 503, 504, 507}
 
     def test_protocol_clean_on_real_tree(self, tree):
         allow = load_allowlist()

@@ -400,7 +400,7 @@ class TestHybridCluster:
                     _assert_parity(got, want[q], ctx=f"{method}:{q}")
                     assert hdrs.get("X-Search-Stages", "").startswith(
                         f"sparse,dense; fusion={method}")
-                    assert hdrs.get("X-Proto-Version") == "3"
+                    assert hdrs.get("X-Proto-Version") == "4"
         finally:
             _stop_all(nodes)
 

@@ -293,8 +293,8 @@ def test_worker_wire_entrypoint_matches_hit_list_path(tmp_path):
         worker_search_batch = _S.worker_search_batch
         worker_search_batch_wire = _S.worker_search_batch_wire
         _compile_bucket = _S._compile_bucket
-        _is_transient_compile_error = staticmethod(
-            _S._is_transient_compile_error)
+        _is_retryable_compute_fault = staticmethod(
+            _S._is_retryable_compute_fault)
 
         def __init__(self, engine, config):
             self.engine = engine

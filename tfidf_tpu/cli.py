@@ -610,6 +610,10 @@ def cmd_status(args) -> int:
     # budget, hit/skip rates from the same sweep. A member with
     # tiering off (or predating it) simply has no row.
     tiers = []
+    # compute-plane health summary (README "Compute-plane failure
+    # semantics"): per-member device state machine from the same
+    # sweep. A member predating it simply has no row.
+    compute = []
     for role, member in members:
         try:
             h = json.loads(http_get(
@@ -638,6 +642,19 @@ def cmd_status(args) -> int:
                     "hit_rate": tier.get("hit_rate", 0.0),
                     "skip_rate": tier.get("skip_rate", 0.0),
                     "ring_stall_s": tier.get("ring_stall_s", 0.0)})
+            comp = h.get("compute")
+            if comp:
+                compute.append({
+                    "url": member,
+                    "state": comp.get("state"),
+                    "consecutive_faults":
+                        int(comp.get("consecutive_faults", 0)),
+                    "total_faults": int(comp.get("total_faults", 0)),
+                    "faults_by_kind": comp.get("faults_by_kind", {}),
+                    "recovery_probes":
+                        int(comp.get("recovery_probes", 0)),
+                    "fallback_available":
+                        bool(comp.get("fallback_available"))})
         except Exception:
             versions.append({"url": member, "role": role,
                              "proto_version": None,
@@ -663,6 +680,17 @@ def cmd_status(args) -> int:
         "hot_segments_total": sum(t["hot_segments"] for t in tiers),
         "cold_segments_total": sum(t["cold_segments"] for t in tiers),
         "hot_bytes_total": sum(t["hot_bytes"] for t in tiers),
+    }
+    out["compute"] = {
+        "nodes": compute,
+        "sick_nodes": sorted(c["url"] for c in compute
+                             if c["state"] == "sick"),
+        "degraded_nodes": sorted(c["url"] for c in compute
+                                 if c["state"] == "degraded"),
+        "fallback_served_total":
+            int(metrics.get("compute_fallback_served", 0)),
+        "poison_quarantined_total":
+            int(metrics.get("poison_quarantined", 0)),
     }
     out["admission"] = {
         "admitted_total": int(metrics.get("admission_admitted", 0)),
@@ -902,6 +930,25 @@ def cmd_faults(args) -> int:
     return 2
 
 
+def cmd_quarantine(args) -> int:
+    """``quarantine``: inspect (or ``--clear``) the poison-query
+    quarantine on a node or router. The snapshot shows every tracked
+    fingerprint with the distinct replicas that blamed it and how old
+    the verdict is; ``--clear`` drops the table (operator override
+    after a bad deploy is rolled back) and prints how many quarantined
+    entries were released."""
+    from tfidf_tpu.cluster.node import http_get, http_post
+
+    url = args.url.rstrip("/")
+    if args.clear:
+        resp = json.loads(http_post(url + "/api/quarantine", b"{}"))
+        print(json.dumps(resp, indent=1))
+        return 0
+    snap = json.loads(http_get(url + "/api/quarantine"))
+    print(json.dumps(snap, indent=1))
+    return 0
+
+
 def cmd_scrub(args) -> int:
     """``scrub``: storage-integrity verification. With ``--url`` it
     triggers one scrub pass on a RUNNING node (``POST /admin/scrub`` —
@@ -1110,6 +1157,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--index-path")
     s.add_argument("--documents-path")
     s.set_defaults(fn=cmd_scrub)
+
+    s = sub.add_parser("quarantine",
+                       help="inspect / clear the poison-query "
+                            "quarantine on a node or router")
+    s.add_argument("url", help="node or router base URL")
+    s.add_argument("--clear", action="store_true",
+                   help="drop the quarantine table (operator override)")
+    s.set_defaults(fn=cmd_quarantine)
 
     s = sub.add_parser("faults",
                        help="chaos tooling: inspect fault points")

@@ -59,11 +59,14 @@ from tfidf_tpu.cluster.protover import (PROTO_REJECTED_HEADER,
                                         PROTO_VERSION, proto_headers)
 from tfidf_tpu.cluster.placement import PlacementFollower, PlacementMap
 from tfidf_tpu.cluster.rebalance import Rebalancer
+from tfidf_tpu.cluster.quarantine import (PoisonQuarantine,
+                                          poison_fingerprint)
 from tfidf_tpu.cluster.registry import (ServiceRegistry,
                                         publish_leader_info,
                                         read_leader_info)
 from tfidf_tpu.cluster.resilience import (ClusterResilience,
                                           RpcStatusError,
+                                          classify_compute_fault,
                                           is_fence_rejection)
 # the read plane (scatter/merge/failover/hedge spine + the shared HTTP
 # handler plumbing) lives in cluster/router.py — the scale-out query
@@ -144,6 +147,16 @@ class _ScatterClient:
         # by SearchNode.start once the server port is known)
         self.origin = ""
 
+    def pop_degraded(self) -> bool:
+        """Did the LAST 2xx reply on THIS thread carry
+        ``X-Compute-Degraded``? Thread-local (the scatter pool runs one
+        RPC per thread at a time), popped by the gatherer right after
+        the call returns — so one request's degraded verdict can never
+        leak into a concurrent request's health marker."""
+        v = getattr(self._tls, "degraded", False)
+        self._tls.degraded = False
+        return v
+
     def post(self, base: str, path: str, data: bytes,
              timeout: float = 10.0, live: set[str] | None = None,
              headers: dict[str, str] | None = None) -> bytes:
@@ -207,6 +220,7 @@ class _ScatterClient:
                         ra_s = float(ra) if ra else None
                     except ValueError:
                         ra_s = None   # HTTP-date form: treat as absent
+                    fps = r.getheader("X-Poison-Fingerprints") or ""
                     raise RpcStatusError(
                         f"{base}{path}", r.status,
                         deadline_exceeded=(
@@ -215,7 +229,15 @@ class _ScatterClient:
                         fenced=(r.getheader(FENCE_REJECTED_HEADER)
                                 == "1"),
                         proto=(r.getheader(PROTO_REJECTED_HEADER)
-                               == "1"))
+                               == "1"),
+                        compute_fault=r.getheader("X-Compute-Fault"),
+                        poison_fps=tuple(
+                            f for f in fps.split(",") if f))
+                # host-fallback honesty flows through the gather: a 2xx
+                # served by the worker's numpy mirror is exact but
+                # degraded — the gatherer pops this per-thread flag
+                self._tls.degraded = (
+                    r.getheader("X-Compute-Degraded") == "1")
                 return body
             except RuntimeError:
                 raise
@@ -396,6 +418,14 @@ class SearchNode(ScatterReadPlane):
             self.config.replay_capture_path,
             self.config.replay_capture_max)
             if self.config.replay_capture_path else None)
+        # poison-query quarantine (ISSUE 20, cluster/quarantine.py):
+        # the read plane's memory of (query, plan) pairs that killed
+        # devices on distinct replicas — consulted by _serve_search
+        # before any fan-out, fed by _gather_merge's per-worker blame
+        self.quarantine = PoisonQuarantine(
+            after=self.config.poison_quarantine_after,
+            ttl_s=self.config.poison_quarantine_ttl_s,
+            max_entries=self.config.poison_quarantine_max)
         self._result_gen = 0
         self._result_gen_lock = threading.Lock()
         # cached role for /api/health: the real is_leader() is a
@@ -663,16 +693,17 @@ class SearchNode(ScatterReadPlane):
             return self.batcher.search(query, unbounded=unbounded)
         return self.engine.search(query, unbounded=unbounded)
 
-    # the tunnel's remote-compile service flakes as transient HTTP 500s
-    # with these markers in the error; only THIS signature is worth a
-    # blind retry (the old gate matched the substring "compile" anywhere
-    # in repr(e), retrying arbitrary unrelated errors — ADVICE r5)
+    # Retry gate classifier: the structured compute-fault taxonomy
+    # (cluster/resilience.classify_compute_fault — the same function
+    # the engine's health machine and the leader's poison quarantine
+    # use, so the three can never drift). Only "compile" (the tunnel's
+    # remote-compile flakes, a fresh executable may succeed) and
+    # "transient" (one-off dispatch failure) earn the single budgeted
+    # retry; "oom" already ran the engine's batch-backoff ladder and
+    # "poison" must surface unretried for the leader to quarantine.
     @staticmethod
-    def _is_transient_compile_error(e: BaseException) -> bool:
-        r = repr(e).lower()
-        if "remote_compile" in r or "tpu_compile_helper" in r:
-            return True
-        return "http 500" in r and "compile" in r
+    def _is_retryable_compute_fault(e: BaseException) -> bool:
+        return classify_compute_fault(e) in ("compile", "transient")
 
     def _compile_bucket(self, n_queries: int) -> int:
         """Query batches pad to power-of-two buckets; the retry budget is
@@ -704,7 +735,7 @@ class SearchNode(ScatterReadPlane):
         try:
             out = run()
         except Exception as e:
-            if not self._is_transient_compile_error(e):
+            if not self._is_retryable_compute_fault(e):
                 raise
             with self._compile_retry_lock:
                 used = self._compile_retries_used.get(bucket, 0)
@@ -2557,7 +2588,45 @@ class _NodeHandler(_HttpHandlerBase):
                     # hit/skip rates — {"enabled": false} when off.
                     # JSON body only; no header/endpoint change, so
                     # the wire fingerprint is untouched.
-                    "tier": node.engine.tier_stats()})
+                    "tier": node.engine.tier_stats(),
+                    # compute-plane health (ISSUE 20): the per-worker
+                    # device state machine (healthy|degraded|sick),
+                    # fault/fallback counters, and whether a host
+                    # mirror exists for this snapshot — the leader's
+                    # placement and the router's owner-merge read this
+                    # to route around a sick device. JSON body only.
+                    "compute": node.engine.compute_stats()})
+            elif u.path == "/api/ready":
+                # readinessProbe target (deploy/k8s.yaml): a SICK
+                # compute plane with no host fallback cannot answer
+                # queries — take the pod out of Service endpoints
+                # until the device recovers. Degraded (host-fallback)
+                # serving stays READY: slower, but exact. Liveness
+                # stays /api/health — a sick device is not a reason
+                # to restart the process (restart would not heal HBM,
+                # and the WAL replay would just add downtime).
+                cs = node.engine.compute_stats()
+                if cs.get("state") == "sick" and not cs.get(
+                        "fallback_available"):
+                    self._json({"ready": False, "compute": cs}, 503,
+                               headers={"Retry-After": "1"})
+                else:
+                    self._json({"ready": True, "compute": cs})
+            elif u.path == "/api/quarantine":
+                # poison-query quarantine table (leader/router-side
+                # state; a plain worker answers an empty table) — the
+                # CLI `quarantine` command reads this
+                self._json(node.quarantine.snapshot())
+            elif u.path == "/api/device-nemesis":
+                # armed compute-chaos rules (observability; the POST
+                # that arms them is config-gated — see do_POST)
+                from tfidf_tpu.utils.device_nemesis import \
+                    global_device_nemesis as _dn
+                if not node.config.device_nemesis_api:
+                    self._text("device nemesis disabled "
+                               "(config.device_nemesis_api=False)", 403)
+                    return
+                self._json(_dn.snapshot())
             elif u.path == "/worker/index-size":
                 self._text(str(node.engine.index_size_bytes()))
             elif u.path == "/worker/names":
@@ -2671,8 +2740,14 @@ class _NodeHandler(_HttpHandlerBase):
                         log.warning("search failed", err=repr(e))
                         hits = []
                     # queries_served is counted once, by Searcher.search
+                    # (the degraded flag is popped even on this parity
+                    # endpoint: a stale thread-local would mis-stamp
+                    # the NEXT batch this handler thread serves)
+                    dh = ({"X-Compute-Degraded": "1"}
+                          if node.engine.pop_fallback_served() else None)
                     self._json([{"document": {"name": h.name},
-                                 "score": h.score} for h in hits])
+                                 "score": h.score} for h in hits],
+                               headers=dh)
             elif u.path == "/worker/process-batch":
                 # batched scatter RPC (leader-internal; packed reply —
                 # see cluster/wire.py). The per-query endpoint above
@@ -2753,14 +2828,42 @@ class _NodeHandler(_HttpHandlerBase):
                         # /worker/process endpoint above keeps the
                         # reference's []-on-failure parity shape,
                         # Worker.java:183; this endpoint is
-                        # leader-internal.)
+                        # leader-internal.) A classified compute fault
+                        # rides X-Compute-Fault so the leader's retry
+                        # gate and quarantine see the taxonomy instead
+                        # of string-matching the repr; a poisoned
+                        # output additionally names the guilty query
+                        # rows (X-Poison-Fingerprints) so the
+                        # quarantine never blames innocent cohort
+                        # queries that merely shared the batch.
                         global_metrics.inc("worker_batch_failures")
                         span_event("worker_batch_failed",
                                    err=repr(e)[:120])
                         log.warning("batch search failed", err=repr(e))
-                        self._text(f"batch search failed: {e!r}", 500)
+                        eh: dict[str, str] = {}
+                        fault = classify_compute_fault(e)
+                        if fault is not None:
+                            eh["X-Compute-Fault"] = fault
+                            qrows = getattr(e, "queries", ())
+                            if fault == "poison" and qrows:
+                                eh["X-Poison-Fingerprints"] = ",".join(
+                                    poison_fingerprint(q, mode)
+                                    for q in qrows)
+                        self._send(
+                            500,
+                            f"batch search failed: {e!r}".encode(),
+                            "text/plain; charset=utf-8", headers=eh)
                         return
-                    self._send(200, body, "application/octet-stream")
+                    # host-fallback honesty: when the engine served
+                    # this batch from the numpy mirror (degraded, not
+                    # wrong — scores are bit-exact), say so on the
+                    # wire so the leader can surface X-Compute-Degraded
+                    # end-to-end instead of silently presenting sick
+                    # hardware as healthy
+                    dh = ({"X-Compute-Degraded": "1"}
+                          if node.engine.pop_fallback_served() else None)
+                    self._send(200, body, "application/octet-stream",
+                               headers=dh)
             elif u.path == "/worker/upload":
                 name, data = self._read_upload(u)
                 if self._fence_check():   # after the body read: the
@@ -2911,6 +3014,35 @@ class _NodeHandler(_HttpHandlerBase):
                     return
                 self._json({"autopilot":
                             node.autopilot.set_enabled(req["enabled"])})
+            elif u.path == "/api/quarantine":
+                # operator override after a fix rolls out: drop every
+                # poison verdict on THIS node's read plane
+                self._json({"cleared": node.quarantine.clear()})
+            elif u.path == "/api/device-nemesis":
+                # scriptable compute-plane chaos (ISSUE 20,
+                # utils/device_nemesis.py) — double-gated: the config
+                # knob must opt in AND the rule grammar is the same
+                # one TFIDF_DEVICE_NEMESIS accepts. Body:
+                # {"script": "site:kind[:prob[:k=v;...]] ..."} to arm,
+                # {"clear": true} to drop rules + lift sick,
+                # {"heal": true} to lift sick only. Never enabled in
+                # production configs; refusing with 403 (not 404)
+                # makes a misconfigured chaos suite loud.
+                from tfidf_tpu.utils.device_nemesis import \
+                    global_device_nemesis as _dn
+                if not node.config.device_nemesis_api:
+                    self._text("device nemesis disabled "
+                               "(config.device_nemesis_api=False)", 403)
+                    return
+                req = json.loads(self._body().decode("utf-8"))
+                if req.get("clear"):
+                    _dn.clear()
+                elif req.get("heal"):
+                    _dn.heal()
+                spec = req.get("script")
+                rids = _dn.script(str(spec)) if spec else []
+                self._json({"armed": _dn.armed, "sick": _dn.sick,
+                            "rules": rids})
             elif u.path == "/admin/checkpoint":
                 # on-demand durability point (reference analog: the
                 # per-upload indexWriter.commit(), Worker.java:138)

@@ -58,13 +58,22 @@ a way an old peer could misread; update the README fingerprint and the
      v2 worker that ignores ``mode`` replies n lists, which the
      leader's slot-count check catches (honest degradation, never a
      silently sparse-only "hybrid" result).
+  4  compute-plane chaos (ISSUE 20): additive reply headers only.
+     Workers stamp X-Compute-Degraded on 2xx replies served from the
+     host mirror and X-Compute-Fault (+ X-Poison-Fingerprints for
+     poison) on compute-fault 500s; the read plane answers 422 +
+     X-Poison-Quarantined for quarantined queries and relays
+     X-Compute-Degraded on merged replies. A v3 peer ignoring every
+     new header sees the v3 wire unchanged (extra headers on replies
+     it already handles; the 422 is the application-rejection class
+     v3 clients already never retry).
 """
 
 from __future__ import annotations
 
 # the current wire-protocol version this binary speaks (see history
 # table above — bump beside any wire-surface change)
-PROTO_VERSION = 3
+PROTO_VERSION = 4
 
 # the wire contract (stamped/checked at the shared HTTP seams)
 PROTO_HEADER = "X-Proto-Version"

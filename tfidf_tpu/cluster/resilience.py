@@ -80,20 +80,37 @@ class RpcStatusError(RuntimeError):
                  deadline_exceeded: bool = False,
                  retry_after_s: float | None = None,
                  fenced: bool = False,
-                 proto: bool = False) -> None:
+                 proto: bool = False,
+                 compute_fault: str | None = None,
+                 poison_fps: tuple[str, ...] = ()) -> None:
         super().__init__(f"{url} -> {status}"
                          + (" (deadline exceeded)" if deadline_exceeded
                             else "")
                          + (" (fenced: stale leader epoch)" if fenced
                             else "")
                          + (" (proto: version outside compat window)"
-                            if proto else ""))
+                            if proto else "")
+                         + (f" (compute fault: {compute_fault})"
+                            if compute_fault else ""))
         self.url = url
         self.status = status
         self.deadline_exceeded = deadline_exceeded
         self.retry_after_s = retry_after_s
         self.fenced = fenced
         self.proto = proto
+        # ``X-Compute-Fault`` reply header: the worker's DEVICE failed
+        # (oom/compile/transient/poison taxonomy below), not its
+        # process or the network. Never retried (the same batch would
+        # hit the same device state — the retry storm the taxonomy
+        # exists to prevent); a poison fault additionally never indicts
+        # the worker (the QUERY is at fault, and the leader's
+        # quarantine — not the breaker — is the right response).
+        self.compute_fault = compute_fault
+        # ``X-Poison-Fingerprints``: per-query blame for a poison fault
+        # (cluster/quarantine.py fingerprints), so a coalesced batch's
+        # innocent cohort is never quarantined alongside the poison
+        # query.
+        self.poison_fps = tuple(poison_fps)
 
 
 class CircuitOpenError(RuntimeError):
@@ -207,6 +224,76 @@ def is_proto_rejection(e: BaseException) -> bool:
     return False
 
 
+# message fragments that identify a device fault class when the
+# exception TYPE alone cannot (XlaRuntimeError and friends are raised
+# by jaxlib with the class buried in the message) — checked in order,
+# first hit wins. The structured replacement for the string-match
+# compile-retry gate this file's classifier superseded
+# (cluster/node.py's old `"remote_compile" in repr(e)`).
+_COMPUTE_OOM_MARKS = ("resource_exhausted", "out of memory", "oom")
+_COMPUTE_COMPILE_MARKS = ("remote_compile", "tpu_compile_helper",
+                          "compilation failure", "compile failed",
+                          "compilation failed", "xla compilation")
+
+
+def classify_compute_fault(e: BaseException) -> str | None:
+    """The compute-fault taxonomy: ``"oom"`` / ``"compile"`` /
+    ``"transient"`` / ``"poison"``, or None for anything that is not a
+    device fault.
+
+    Classification is exception-type first (the device nemesis and the
+    fetch-seam poison detector raise typed exceptions), message
+    taxonomy second (real jaxlib ``XlaRuntimeError``s carry the class
+    in the message), and is shared by every consumer — the worker's
+    compile-retry gate, the engine's ComputeHealth state machine, and
+    the leader's poison quarantine — so the three can never drift on
+    what counts as which fault. An ``RpcStatusError`` carrying a
+    worker's ``X-Compute-Fault`` stamp classifies as that stamp (the
+    worker already ran this function next to the device)."""
+    stamped = getattr(e, "compute_fault", None)
+    if stamped is not None:
+        return stamped
+    from tfidf_tpu.utils.device_nemesis import (DeviceCompileError,
+                                                DeviceFault,
+                                                DeviceOOMError,
+                                                DevicePoisonedOutput,
+                                                DeviceSickError,
+                                                DeviceTransientError)
+    if isinstance(e, DevicePoisonedOutput):
+        return "poison"
+    if isinstance(e, DeviceOOMError):
+        return "oom"
+    if isinstance(e, DeviceCompileError):
+        return "compile"
+    if isinstance(e, (DeviceTransientError, DeviceSickError)):
+        return "transient"
+    if isinstance(e, DeviceFault):
+        return "transient"
+    # real jax/jaxlib runtime errors: match on type name (jaxlib's
+    # exception classes move between modules across versions — and the
+    # CPU-only test image may not expose them at a stable import path)
+    tname = type(e).__name__
+    if tname in ("XlaRuntimeError", "JaxRuntimeError", "InternalError",
+                 "ResourceExhaustedError"):
+        msg = str(e).lower()
+        if any(m in msg for m in _COMPUTE_OOM_MARKS):
+            return "oom"
+        if any(m in msg for m in _COMPUTE_COMPILE_MARKS):
+            return "compile"
+        return "transient"
+    # the TPU tunnel surfaces remote-compile/OOM failures as PLAIN
+    # RuntimeError: classify by the marks alone, and never default a
+    # generic RuntimeError to "transient" — an arbitrary RuntimeError
+    # is not a device fault
+    if isinstance(e, RuntimeError):
+        msg = str(e).lower()
+        if any(m in msg for m in _COMPUTE_OOM_MARKS):
+            return "oom"
+        if any(m in msg for m in _COMPUTE_COMPILE_MARKS):
+            return "compile"
+    return None
+
+
 def is_retryable(e: BaseException) -> bool:
     """Default retry classifier: transient transport failures,
     gateway-transient statuses (502/503/504), and 429 admission sheds
@@ -233,6 +320,13 @@ def is_retryable(e: BaseException) -> bool:
     if isinstance(e, RpcStatusError):
         if e.deadline_exceeded:
             return False   # the caller's budget is spent; honest failure
+        if e.compute_fault is not None:
+            # a device fault is deterministic on the worker's current
+            # device state: re-sending the same batch would multiply
+            # the sick device's load attempt-fold (the retry storm).
+            # Per-request FAILOVER to a replica — not retry to the same
+            # worker — is the recovery path.
+            return False
         return e.status in _TRANSIENT_STATUSES or e.status == _SHED_STATUS
     if isinstance(e, urllib.error.HTTPError):
         return e.code in _TRANSIENT_STATUSES or e.code == _SHED_STATUS
@@ -264,6 +358,12 @@ def is_worker_fault(e: BaseException) -> bool:
     if isinstance(e, RpcStatusError):
         if e.deadline_exceeded:
             return False   # honest refusal from a healthy worker
+        if e.compute_fault == "poison":
+            # the QUERY is at fault, not the worker: a poison query
+            # serially tripping every replica's breaker is exactly the
+            # cascade the quarantine exists to stop — the worker stays
+            # in rotation and the leader quarantines the fingerprint
+            return False
         return e.status >= 500 and e.status != _STORAGE_FULL_STATUS
     if isinstance(e, urllib.error.HTTPError):
         return e.code >= 500 and e.code != _STORAGE_FULL_STATUS

@@ -67,6 +67,8 @@ from tfidf_tpu.cluster.protover import (PROTO_HEADER,
                                         PROTO_REJECTED_HEADER,
                                         PROTO_STATUS, PROTO_VERSION,
                                         in_window, parse_version)
+from tfidf_tpu.cluster.quarantine import (PoisonQuarantine,
+                                          poison_fingerprint)
 from tfidf_tpu.cluster.registry import ServiceRegistry, read_leader_info
 from tfidf_tpu.cluster.resilience import (CircuitOpenError,
                                           ClusterResilience,
@@ -145,6 +147,7 @@ class ScatterReadPlane:
     registry: ServiceRegistry
     placement: PlacementMap
     resilience: ClusterResilience
+    quarantine: PoisonQuarantine
 
     # ---- policy hooks ----
 
@@ -521,14 +524,24 @@ class ScatterReadPlane:
         if tparent is not None and not tparent.sampled:
             tparent = None
 
+        # workers whose 2xx reply carried X-Compute-Degraded (served
+        # from the host mirror: exact scores, sick device) — a
+        # per-request set, recorded on the pool thread that ran the RPC
+        # (set.add is atomic under the GIL), so concurrent scatters
+        # never mislabel each other
+        compute_degraded: set[str] = set()
+
         def call(addr: str):
             # scatter RPCs feed the gray-failure latency EWMA (slow
             # worker detection is scoped to THIS path — bulk uploads
             # legitimately take minutes and must not condemn a worker)
             def run():
-                return self.resilience.worker_call(
+                r = self.resilience.worker_call(
                     addr, lambda: rpc_one(addr, live, t_deadline),
                     track_latency=True)
+                if self._scatter.pop_degraded():
+                    compute_degraded.add(addr)
+                return r
             if tparent is None:
                 return run()
             with global_tracer.span("scatter.worker", parent=tparent,
@@ -653,7 +666,16 @@ class ScatterReadPlane:
             except Exception as e:
                 # per-worker tolerance (Leader.java:67-69) — a reply
                 # that fails wire validation degrades exactly like a
-                # failed RPC; failover below recovers the mapped slice
+                # failed RPC; failover below recovers the mapped slice.
+                # A poison verdict (the worker named the guilty query
+                # rows in X-Poison-Fingerprints) is blamed per-worker
+                # into the quarantine BEFORE failover re-issues the
+                # slice: the re-issue may kill the backup's device too,
+                # and its blame (a DISTINCT replica) is what crosses
+                # the quarantine threshold — stopping the
+                # query-of-death march before a third replica dies.
+                for fp in getattr(e, "poison_fps", ()):
+                    self.quarantine.note_fault(fp, addr)
                 failed.add(addr)
                 global_metrics.inc("scatter_failures")
                 log.warning("worker failed during search", worker=addr,
@@ -728,6 +750,11 @@ class ScatterReadPlane:
                     hit_lists = fut.result(timeout=max(
                         0.0, t_deadline - time.monotonic()) + 30.0)
                 except Exception as e:
+                    # replica-distinct poison blame: a backup whose
+                    # device ALSO died on the re-issued slice is the
+                    # second independent witness the quarantine needs
+                    for fp in getattr(e, "poison_fps", ()):
+                        self.quarantine.note_fault(fp, backup)
                     failed_backups.add(backup)
                     global_metrics.inc("scatter_failover_failures")
                     log.warning("failover slice failed", worker=backup,
@@ -802,6 +829,16 @@ class ScatterReadPlane:
         epoch, gen = self._view_stamp(pmap)
         health["route_epoch"] = epoch
         health["route_gen"] = gen
+        # compute-plane degradation is a SEPARATE axis from result
+        # degradation: a host-fallback reply is complete and exact
+        # (bit-compared against the device path), just slower — the
+        # `degraded` marker above stays about result completeness,
+        # and this count lets the handler stamp X-Compute-Degraded
+        # honestly without conflating the two
+        health["compute_degraded"] = sum(
+            1 for w in compute_degraded if w in ok)
+        if health["compute_degraded"]:
+            global_metrics.inc("scatter_compute_degraded")
         if tparent is not None:
             # the request story's verdict, on the scatter span itself:
             # chaos suites assert degraded/failover counts from here
@@ -1208,6 +1245,25 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
                                      "(embedding_enabled=False)",
                             "mode": mode}, code=400)
                 return
+            # poison-query quarantine (after plan validation — a
+            # malformed request is 400, not a quarantine verdict): a
+            # (query, plan) pair that killed devices on ≥ N distinct
+            # replicas is refused at the front door with 422 — the
+            # application-rejection class clients must not retry —
+            # before any worker is touched
+            fp = poison_fingerprint(query, mode)
+            if node.quarantine.is_quarantined(fp):
+                global_metrics.inc("poison_quarantine_hits")
+                sp.set_attr("poison_quarantined", 1)
+                self._json({"error": "query quarantined: repeated "
+                                     "compute faults on distinct "
+                                     "replicas",
+                            "fingerprint": fp,
+                            "retry_after_s":
+                                node.config.poison_quarantine_ttl_s},
+                           code=422,
+                           headers={"X-Poison-Quarantined": fp})
+                return
             # traffic-capture tap: every ADMITTED search lands in the
             # durable request log (query + arrival offset + lane +
             # client) when capture is armed — shed requests are
@@ -1243,6 +1299,15 @@ class _HttpHandlerBase(BaseHTTPRequestHandler):
                 hdrs["X-Route-Generation"] = str(health["route_gen"])
             if health.get("cached"):
                 sp.set_attr("cached", 1)
+            # compute-plane honesty, end to end: some worker served
+            # its share from the host mirror (exact scores, sick
+            # device) — distinct from X-Scatter-Degraded, which is
+            # about result completeness
+            if health.get("compute_degraded"):
+                hdrs["X-Compute-Degraded"] = str(
+                    health["compute_degraded"])
+                sp.set_attr("compute_degraded",
+                            health["compute_degraded"])
             sp.set_attr("degraded", health.get("degraded", 0))
             if health.get("degraded"):
                 hdrs["X-Scatter-Degraded"] = (
@@ -1510,6 +1575,10 @@ class _RouterHandler(_HttpHandlerBase):
                             "decisions": router.autopilot.decisions(n)})
             elif u.path == "/api/routers":
                 self._json(list_routers(router.coord))
+            elif u.path == "/api/quarantine":
+                # THIS router's poison-quarantine table (per-router
+                # state; observability lane, never admission-controlled)
+                self._json(router.quarantine.snapshot())
             elif u.path == "/leader/download":
                 self._serve_leader_download(u)
             elif self._serve_metrics(u):
@@ -1530,6 +1599,10 @@ class _RouterHandler(_HttpHandlerBase):
                 return
             if u.path == "/leader/start":
                 self._serve_search()
+            elif u.path == "/api/quarantine":
+                # operator override after a fix rolls out: drop every
+                # verdict on THIS router (per-router state — clear each)
+                self._json({"cleared": router.quarantine.clear()})
             elif u.path in self._PROXY_POSTS:
                 self._forward_write(u)
             else:
@@ -1640,6 +1713,14 @@ class QueryRouter(ScatterReadPlane):
             self.config.replay_capture_path,
             self.config.replay_capture_max)
             if self.config.replay_capture_path else None)
+        # per-router poison-query quarantine: each router learns blame
+        # from its OWN scatter failures (no coordination write — a
+        # query-of-death hammering one router is quarantined there;
+        # other routers learn the same way if it reaches them)
+        self.quarantine = PoisonQuarantine(
+            after=self.config.poison_quarantine_after,
+            ttl_s=self.config.poison_quarantine_ttl_s,
+            max_entries=self.config.poison_quarantine_max)
         # per-router SLO autopilot (cluster/autopilot.py): the router
         # owns its OWN admission, hedge, linger, and slow-trip knobs —
         # the same live objects the leader's loop steers — so the

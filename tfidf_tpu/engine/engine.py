@@ -17,6 +17,9 @@ import itertools
 import os
 import threading
 
+from tfidf_tpu.engine.compute_health import (ComputeHealth,
+                                             FallbackUnsupported,
+                                             HostFallbackScorer)
 from tfidf_tpu.engine.index import ShardIndex
 from tfidf_tpu.engine.segments import SegmentedIndex
 from tfidf_tpu.engine.searcher import Searcher, SearchHit
@@ -49,6 +52,16 @@ class Engine:
         self._write_lock = threading.RLock()
         self.dense = None    # set below; stays None for mesh layouts
         self.tier = None     # set below for tiered segments mode only
+        # compute-plane health (ISSUE 20): every search entry point
+        # routes through _run_compute, which classifies device faults,
+        # advances this state machine, and — local plain-snapshot mode
+        # only — serves from the bit-exact host mirror while sick.
+        self.compute = ComputeHealth(
+            degraded_after=c.compute_degraded_after,
+            sick_after=c.compute_sick_after,
+            probe_interval_s=c.compute_probe_interval_s)
+        self._fallback: HostFallbackScorer | None = None
+        self._fallback_tls = threading.local()
         self.analyzer = Analyzer(
             lowercase=c.lowercase,
             stopwords=frozenset(c.stopwords),
@@ -163,6 +176,11 @@ class Engine:
             kernel_a_build=c.kernel_a_build,
             pipeline_depth=c.search_pipeline_depth,
             pipeline_mode=c.search_pipeline_mode)
+        # host-fallback degraded scoring rides only the local Searcher
+        # (mesh modes returned above; segmented snapshots are rejected
+        # lazily by the scorer itself with FallbackUnsupported)
+        if c.compute_fallback:
+            self._fallback = HostFallbackScorer(self.searcher)
         # dense plane (ISSUE 17): a per-doc embedding column beside the
         # sparse postings, mutated by the same ingest/delete calls under
         # the same write lock and committed by the same commit(). Local
@@ -383,14 +401,133 @@ class Engine:
         return n
 
     # ---- search (Worker.processDocuments analog) ----
+    #
+    # Every entry point routes through _run_compute (ISSUE 20): device
+    # faults are classified (cluster/resilience.classify_compute_fault),
+    # advance the ComputeHealth machine, trigger the OOM batch-backoff
+    # ladder, and — when a host mirror exists — degrade to bit-exact
+    # host scoring instead of failing the request. Poison (NaN output)
+    # is NEVER absorbed: it re-raises so the worker handler can stamp
+    # X-Compute-Fault: poison and the leader can quarantine the query.
+
+    def _serve_fallback(self, queries, fallback_fn):
+        """Run the host mirror; returns ``(served, result)`` —
+        ``served`` False means the mirror does not support the active
+        snapshot (segmented/mesh) and the caller should keep going."""
+        try:
+            out = fallback_fn(queries)
+        except FallbackUnsupported:
+            return False, None
+        global_metrics.inc("compute_fallback_served", max(1, len(queries)))
+        self._fallback_tls.flag = True
+        return True, out
+
+    def pop_fallback_served(self) -> bool:
+        """True iff a fallback answer was served on THIS thread since
+        the last pop — the worker handler's X-Compute-Degraded stamp
+        (thread-local: one HTTP request == one handler thread)."""
+        served = getattr(self._fallback_tls, "flag", False)
+        self._fallback_tls.flag = False
+        return served
+
+    def _oom_ladder(self, queries, device_fn):
+        """Alloc-OOM batch backoff: retry the WHOLE query list in
+        sub-batches of B/2, B/4, ... down to ``oom_backoff_min_batch``.
+        Returns the list of partial results, or None when the floor is
+        reached with OOM still firing. Non-OOM faults mid-ladder
+        re-raise (the ladder only buys memory, not health)."""
+        bsz = len(queries) // 2
+        floor = max(1, int(self.config.oom_backoff_min_batch))
+        while bsz >= floor:
+            global_metrics.inc("compute_oom_backoff")
+            log.warning("device OOM: retrying at smaller batch",
+                        batch=bsz, queries=len(queries))
+            try:
+                return [device_fn(queries[lo:lo + bsz])
+                        for lo in range(0, len(queries), bsz)]
+            except Exception as e:
+                from tfidf_tpu.cluster.resilience import \
+                    classify_compute_fault
+                kind = classify_compute_fault(e)
+                if kind != "oom":
+                    raise
+                self.compute.note_fault(kind)
+                bsz //= 2
+        return None
+
+    def _run_compute(self, queries, device_fn, fallback_fn, merge):
+        """The compute-plane guard every search path shares.
+
+        ``device_fn(qs)`` scores a query sub-list on device;
+        ``fallback_fn(qs)`` (or None) is the host mirror; ``merge``
+        joins partial results from the OOM ladder. Flow: sick devices
+        skip straight to the fallback (one probe per interval still
+        tries the device — the recovery path); device faults classify,
+        advance health, ladder down on OOM, then degrade or re-raise.
+        """
+        from tfidf_tpu.cluster.resilience import classify_compute_fault
+        fb = fallback_fn if self._fallback is not None else None
+        if queries and fb is not None \
+                and not self.compute.should_try_device():
+            served, out = self._serve_fallback(queries, fb)
+            if served:
+                return out
+        try:
+            out = merge([device_fn(queries)])
+            if queries:
+                self.compute.note_success()
+            return out
+        except Exception as e:
+            kind = classify_compute_fault(e)
+            if kind is None:
+                raise
+            if kind == "poison":
+                # poisoned output is a query/data problem, not a sick
+                # device: never absorbed, never advances health — the
+                # wire stamp + leader quarantine own it
+                global_metrics.inc("compute_poison_outputs")
+                raise
+            self.compute.note_fault(kind)
+            if kind == "oom" and len(queries) > 1:
+                parts = self._oom_ladder(queries, device_fn)
+                if parts is not None:
+                    self.compute.note_success()
+                    return merge(parts)
+            if fb is not None:
+                served, out = self._serve_fallback(queries, fb)
+                if served:
+                    return out
+            raise
+
+    def compute_stats(self) -> dict:
+        """ComputeHealth summary for /api/health and `status`."""
+        d = self.compute.snapshot()
+        d["fallback_available"] = self._fallback is not None
+        return d
 
     def search(self, query: str, k: int | None = None,
                unbounded: bool = False) -> list[SearchHit]:
-        return self.searcher.search([query], k=k, unbounded=unbounded)[0]
+        return self.search_batch([query], k=k, unbounded=unbounded)[0]
 
     def search_batch(self, queries: list[str], k: int | None = None,
                      unbounded: bool = False) -> list[list[SearchHit]]:
-        return self.searcher.search(queries, k=k, unbounded=unbounded)
+        return self._run_compute(
+            queries,
+            lambda qs: self.searcher.search(qs, k=k, unbounded=unbounded),
+            lambda qs: self._fallback.search(qs, k=k, unbounded=unbounded),
+            merge=lambda parts: [hits for p in parts for hits in p])
+
+    @staticmethod
+    def _merge_arrays(parts):
+        """Join OOM-ladder partials from the arrays path: vals/ids
+        concatenate on the query axis; kk and names are
+        batch-invariant (same snapshot, same k)."""
+        if len(parts) == 1:
+            return parts[0]
+        import numpy as np
+        vals = np.concatenate([np.asarray(p[0]) for p in parts], axis=0)
+        ids = np.concatenate([np.asarray(p[1]) for p in parts], axis=0)
+        return vals, ids, parts[0][2], parts[0][3]
 
     def search_batch_arrays(self, queries: list[str],
                             k: int | None = None):
@@ -403,7 +540,11 @@ class Engine:
         arrays = getattr(self.searcher, "search_arrays", None)
         if arrays is None:
             return None
-        return arrays(queries, k=k)
+        return self._run_compute(
+            queries,
+            lambda qs: arrays(qs, k=k),
+            lambda qs: self._fallback.search_arrays(qs, k=k),
+            merge=self._merge_arrays)
 
     # ---- dense plane (ISSUE 17) ----
 
@@ -411,13 +552,22 @@ class Engine:
                            k: int | None = None) -> list[list[tuple]]:
         """Exact dense top-k per query as ``[(name, score), ...]``
         (cosine, sorted by (-score, name)). Loud when the dense plane
-        is off — a silent sparse fallback would fake hybrid results."""
+        is off — a silent sparse fallback would fake hybrid results.
+        Health-guarded but never host-served: MXU matmuls have no
+        bit-exact host mirror, so dense faults surface to the router's
+        failover instead of degrading silently."""
         if self.dense is None:
             raise RuntimeError(
                 "dense plane disabled (embedding_enabled=False)")
         kk = int(k) if k is not None else self.config.top_k
-        counts = [self.analyzer.counts(q) for q in queries]
-        return self.dense.search_batch(counts, kk)
+
+        def run(qs):
+            counts = [self.analyzer.counts(q) for q in qs]
+            return self.dense.search_batch(counts, kk)
+
+        return self._run_compute(
+            queries, run, None,
+            merge=lambda parts: [r for p in parts for r in p])
 
     def search_dense_names(self, queries: list[str],
                            names: list[str]) -> list[dict]:
@@ -426,8 +576,14 @@ class Engine:
         if self.dense is None:
             raise RuntimeError(
                 "dense plane disabled (embedding_enabled=False)")
-        counts = [self.analyzer.counts(q) for q in queries]
-        return self.dense.search_names(counts, names)
+
+        def run(qs):
+            counts = [self.analyzer.counts(q) for q in qs]
+            return self.dense.search_names(counts, names)
+
+        return self._run_compute(
+            queries, run, None,
+            merge=lambda parts: [r for p in parts for r in p])
 
     def dense_stats(self) -> dict | None:
         """Embedding-column summary for /api/health and `status` — None

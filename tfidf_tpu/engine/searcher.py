@@ -297,7 +297,9 @@ class Searcher(QueryVectorizerMixin):
             lambda chunk, packed, kk_: (chunk, fetch_packed(packed),
                                         kk_),
             # assemble: two views of the fetched buffer, pad rows cut
-            lambda chunk, arr, kk_: [unpack_topk(arr[:len(chunk)])])
+            # (the poison check runs on the fetched values exactly like
+            # the hit-assembly path's _assemble)
+            lambda chunk, arr, kk_: [self._checked_unpack(chunk, arr)])
         vals = np.concatenate([p[0] for p in parts], axis=0)
         ids = np.concatenate([p[1] for p in parts], axis=0)
         names = (snap.padded_names if isinstance(snap, SegmentedSnapshot)
@@ -535,8 +537,31 @@ class Searcher(QueryVectorizerMixin):
             ids = np.asarray(ids)
         return self._assemble(snap, queries, vals, ids, rank_n)
 
+    def _checked_unpack(self, chunk: list[str], arr):
+        vals, ids = unpack_topk(arr[:len(chunk)])
+        self._poison_check(chunk, vals)
+        return vals, ids
+
+    @staticmethod
+    def _poison_check(queries: list[str], vals) -> None:
+        """The poison-detection seam: a NaN in a fetched result row is
+        never legitimate (scores are finite by construction; dead/pad
+        entries are 0 or -inf), so it means the device produced garbage
+        for that query — a miscompiled kernel, corrupted HBM, or the
+        nemesis' injected poison. Raises with the OFFENDING query
+        strings only, so the worker can report per-query blame and the
+        leader's quarantine never punishes innocent batch cohorts."""
+        rows = np.isnan(vals[:len(queries)]).any(axis=tuple(
+            range(1, vals.ndim)))
+        if rows.any():
+            from tfidf_tpu.utils.device_nemesis import \
+                DevicePoisonedOutput
+            raise DevicePoisonedOutput(tuple(
+                q for q, bad in zip(queries, rows) if bad))
+
     def _assemble(self, snap: Snapshot, queries: list[str], vals, ids,
                   kk: int) -> list[list[SearchHit]]:
+        self._poison_check(queries, vals)
         segmented = isinstance(snap, SegmentedSnapshot)
         names = snap.padded_names if segmented else snap.doc_names
         results: list[list[SearchHit]] = []

@@ -376,6 +376,13 @@ class TierManager:
         :meth:`fault_in`."""
         import jax.numpy as jnp
 
+        from tfidf_tpu.utils.device_nemesis import device_guard
+
+        # the upload-ring nemesis seam: an injected fault here models a
+        # host->HBM transfer failing (alloc OOM on the upload, a sick
+        # device refusing new buffers); it surfaces to the searcher as
+        # the ring future's exception, i.e. a compute fault mid-query
+        device_guard("upload")
         files = seg.cold if seg.cold is not None else self._spill(seg)
         problems = storage.verify_manifest(files.dir)
         if problems:

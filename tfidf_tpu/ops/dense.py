@@ -31,10 +31,10 @@ from .topk import merge_topk, pack_topk
 
 
 @jax.jit
-def dense_scores(queries: jax.Array,     # f32 [B, dim]
-                 emb: jax.Array,         # f32 [doc_cap, dim]
-                 num_docs: jax.Array,    # i32 scalar — live rows
-                 ) -> jax.Array:
+def _dense_scores_jit(queries: jax.Array,  # f32 [B, dim]
+                      emb: jax.Array,      # f32 [doc_cap, dim]
+                      num_docs: jax.Array,  # i32 scalar — live rows
+                      ) -> jax.Array:
     """Full [B, doc_cap] cosine score matrix (rows are L2-normalized at
     embed time, so the dot IS the cosine). Padded docs score -inf.
     Small-corpus / oracle path — the serving path is the chunked top-k
@@ -50,11 +50,25 @@ def dense_scores(queries: jax.Array,     # f32 [B, dim]
     return jnp.where(live, scores, -jnp.inf)
 
 
+def dense_scores(queries: jax.Array, emb: jax.Array,
+                 num_docs: jax.Array) -> jax.Array:
+    """The dense-oracle dispatch seam (``device.dense``) — nemesis
+    guard around the jitted full score matrix; a fired poison rule NaNs
+    the whole output (dense queries carry no per-row term-count shape,
+    so poison targeting is batch-wide here)."""
+    from tfidf_tpu.utils.device_nemesis import device_guard
+    rule = device_guard("dense", batch=int(queries.shape[0]))
+    scores = _dense_scores_jit(queries, emb, num_docs)
+    if rule is not None:
+        scores = jnp.full_like(scores, jnp.nan)
+    return scores
+
+
 @functools.partial(jax.jit, static_argnames=("k", "chunk"))
-def packed_dense_topk(queries: jax.Array,    # f32 [B, dim]
-                      emb: jax.Array,        # f32 [doc_cap, dim]
-                      num_docs: jax.Array,   # i32 scalar
-                      *, k: int, chunk: int = 1 << 14) -> jax.Array:
+def _packed_dense_topk_jit(queries: jax.Array,  # f32 [B, dim]
+                           emb: jax.Array,      # f32 [doc_cap, dim]
+                           num_docs: jax.Array,  # i32 scalar
+                           *, k: int, chunk: int = 1 << 14) -> jax.Array:
     """Exact dense top-k, packed for the wire (``ops/topk.pack_topk``
     layout: f32 score bits bitcast into i32 lanes beside the ids).
 
@@ -71,7 +85,7 @@ def packed_dense_topk(queries: jax.Array,    # f32 [B, dim]
     n = -(-doc_cap // c)     # ceil: the tail chunk is clamped, not ragged
 
     if n == 1:
-        scores = dense_scores(queries, emb, num_docs)
+        scores = _dense_scores_jit(queries, emb, num_docs)
         vals, idx = jax.lax.top_k(scores, k)
         return pack_topk(vals, idx.astype(jnp.int32))
 
@@ -96,3 +110,22 @@ def packed_dense_topk(queries: jax.Array,    # f32 [B, dim]
     _, (vals, ids) = jax.lax.scan(body, None, offs)      # [n, B, k]
     top_vals, top_ids = merge_topk(vals, ids)
     return pack_topk(top_vals, top_ids)
+
+
+def packed_dense_topk(queries: jax.Array, emb: jax.Array,
+                      num_docs: jax.Array, *, k: int,
+                      chunk: int = 1 << 14) -> jax.Array:
+    """The dense serving dispatch seam (``device.dense``) — nemesis
+    guard around the chunked exact top-k. A fired poison rule bitcasts
+    NaN into every packed value lane, so the corruption is caught at
+    the same fetch seam as the sparse plane's."""
+    from tfidf_tpu.utils.device_nemesis import device_guard
+    rule = device_guard("dense", batch=int(queries.shape[0]))
+    packed = _packed_dense_topk_jit(queries, emb, num_docs, k=k,
+                                    chunk=chunk)
+    if rule is not None:
+        nan_bits = jax.lax.bitcast_convert_type(
+            jnp.full((packed.shape[0], packed.shape[1] // 2), jnp.nan,
+                     jnp.float32), jnp.int32)
+        packed = packed.at[:, :packed.shape[1] // 2].set(nan_bits)
+    return packed

@@ -465,6 +465,37 @@ def _pick_chunk(rows_cap: int, width: int, B: int, doc_chunk: int) -> int:
     return chunk
 
 
+_RED_LANES = 8   # lane width of the explicit ELL reduction order
+
+
+def _lane_sum_w(x: jax.Array) -> jax.Array:
+    """Sum f32 ``x [Dc, W, B]`` over W with a PINNED addition order:
+    strided ``_RED_LANES``-lane accumulation followed by a halving
+    tree, written as explicit adds XLA will not reassociate.
+
+    A plain ``.sum(axis=1)`` lowers to an XLA reduce whose association
+    order is implementation- and shape-dependent (probe: W=8 matches a
+    tree, W>=48 matches no simple order at all), so nothing off-device
+    can reproduce its bits.  Fixing the order in the program costs
+    nothing measurable — the adds still fuse with the gather+mul into
+    one loop — and makes the host-fallback mirror
+    (``engine.compute_health._lane_reduce``, same lane count and tree)
+    bit-exact by construction on every backend."""
+    dc, w, b = x.shape
+    pad = (-w) % _RED_LANES
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((dc, pad, b), jnp.float32)], axis=1)
+    lanes = jnp.zeros((dc, _RED_LANES, b), jnp.float32)
+    for i in range(x.shape[1] // _RED_LANES):
+        lanes = lanes + x[:, i * _RED_LANES:(i + 1) * _RED_LANES]
+    v = _RED_LANES
+    while v > 1:
+        v //= 2
+        lanes = lanes[:, :v] + lanes[:, v:2 * v]
+    return lanes[:, 0]                                # [Dc, B]
+
+
 def _score_block(impact: jax.Array, term: jax.Array,
                  slot_of: jax.Array, qc_t: jax.Array,
                  doc_chunk: int) -> jax.Array:
@@ -481,11 +512,24 @@ def _score_block(impact: jax.Array, term: jax.Array,
     def body(_, xs):
         imp_c, term_c = xs                            # [Dc, W]
         qg = qc_t[slot_of[term_c]]                    # [Dc, W, B] gathers
-        # multiply+reduce, NOT einsum/dot: dot operands must materialize
-        # in HBM, so an einsum here forces the [Dc, W, B] gather output
-        # through memory (measured 3.5x slower at 200k docs); the
-        # reduce-fusion keeps gather+mul+sum in one loop
-        scores_c = (qg * imp_c[:, :, None]).sum(axis=1).T   # [B, Dc]
+        # multiply + explicit-order lane reduce, NOT einsum/dot: dot
+        # operands must materialize in HBM, so an einsum here forces
+        # the [Dc, W, B] gather output through memory (measured 3.5x
+        # slower at 200k docs); the elementwise adds keep
+        # gather+mul+sum in one loop fusion AND pin the f32 addition
+        # order the host fallback mirrors (see _lane_sum_w)
+        prod = qg * imp_c[:, :, None]                 # [Dc, W, B]
+        # contraction fence: without it the backend fuses this multiply
+        # into _lane_sum_w's first add as an FMA (observed on XLA CPU,
+        # 1-ULP drift vs round-then-add), which no host mirror can
+        # reproduce. The select's predicate is runtime data (term ids),
+        # so neither XLA nor LLVM can fold it away, and an add whose
+        # operand is a select — not the multiply itself — is never
+        # contracted. Term ids are always >= 0, so the value is
+        # unchanged; the fence costs one compare+select in a
+        # memory-bound loop.
+        prod = jnp.where(term_c[:, :, None] >= 0, prod, 0.0)
+        scores_c = _lane_sum_w(prod).T                # [B, Dc]
         return None, scores_c
 
     xs = (impact.reshape(n_chunks, chunk, width),
@@ -583,10 +627,30 @@ def score_ell_with_residual(impacts, terms, block_live,
     return scores
 
 
-score_ell_batch = jax.jit(
+_score_ell_batch_jit = jax.jit(
     score_ell_with_residual,
     static_argnames=("model", "k1", "b", "doc_chunk", "res_chunk",
                      "use_pallas", "a_build"))
+
+
+def score_ell_batch(impacts, terms, block_live, res_tf, res_term,
+                    res_doc, doc_len, df, q: QueryBatch, n_docs, avgdl,
+                    doc_norms=None, **kw) -> jax.Array:
+    """The ELL dispatch seam: the jitted scorer behind the device
+    nemesis guard (``device.score_ell``). Unarmed cost is one attribute
+    read; under an armed nemesis this is where injected OOM / compile /
+    transient / sick faults surface and where a fired poison rule's NaN
+    rows enter the output buffer (on device — detection happens at the
+    fetch seam)."""
+    from tfidf_tpu.utils.device_nemesis import device_guard, poison_scores
+    rule = device_guard("score_ell", batch=int(q.slots.shape[0]),
+                        uniq=int(q.uniq.shape[0]))
+    scores = _score_ell_batch_jit(
+        impacts, terms, block_live, res_tf, res_term, res_doc,
+        doc_len, df, q, n_docs, avgdl, doc_norms, **kw)
+    if rule is not None:
+        scores = poison_scores(scores, q.weights, rule.min_uniq)
+    return scores
 
 
 def _score_block_tf(tf: jax.Array, term: jax.Array, dl: jax.Array,
@@ -691,9 +755,24 @@ def score_segments_impl(views, df, q: QueryBatch, n_docs, avgdl,
     return jnp.concatenate(outs, axis=1)
 
 
-score_segments_batch = jax.jit(
+_score_segments_batch_jit = jax.jit(
     score_segments_impl,
     static_argnames=("model", "k1", "b", "doc_chunk"))
+
+
+def score_segments_batch(views, df, q: QueryBatch, n_docs, avgdl,
+                         **kw) -> jax.Array:
+    """The segmented dispatch seam (``device.score_segments``): hot
+    pass, cold walk, and the tier-bypass parity oracle all dispatch
+    through here — see :func:`score_ell_batch` for the guard
+    contract."""
+    from tfidf_tpu.utils.device_nemesis import device_guard, poison_scores
+    rule = device_guard("score_segments", batch=int(q.slots.shape[0]),
+                        uniq=int(q.uniq.shape[0]))
+    scores = _score_segments_batch_jit(views, df, q, n_docs, avgdl, **kw)
+    if rule is not None:
+        scores = poison_scores(scores, q.weights, rule.min_uniq)
+    return scores
 
 
 def cosine_norms_host(coo: CooShard, n_docs: float) -> np.ndarray:

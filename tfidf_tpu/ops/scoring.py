@@ -206,8 +206,24 @@ def score_coo_impl(tf: jax.Array, term: jax.Array, doc: jax.Array,
 
 # Jitted entry point for single-shard use; ``score_coo_impl`` stays callable
 # inside ``shard_map`` bodies (tfidf_tpu.parallel.sharded).
-score_coo_batch = jax.jit(
+_score_coo_batch_jit = jax.jit(
     score_coo_impl, static_argnames=("model", "k1", "b", "chunk"))
+
+
+def score_coo_batch(tf, term, doc, doc_len, df, q: QueryBatch,
+                    n_docs, avgdl, doc_norms=None, **kw) -> jax.Array:
+    """The COO dispatch seam (``device.score_coo``): the jitted scorer
+    behind the device nemesis guard — injected compute faults surface
+    here, and a fired poison rule NaNs its target rows on device (see
+    ``tfidf_tpu.utils.device_nemesis``)."""
+    from tfidf_tpu.utils.device_nemesis import device_guard, poison_scores
+    rule = device_guard("score_coo", batch=int(q.slots.shape[0]),
+                        uniq=int(q.uniq.shape[0]))
+    scores = _score_coo_batch_jit(tf, term, doc, doc_len, df, q,
+                                  n_docs, avgdl, doc_norms, **kw)
+    if rule is not None:
+        scores = poison_scores(scores, q.weights, rule.min_uniq)
+    return scores
 
 
 def cosine_norms(tf: jax.Array, term: jax.Array, doc: jax.Array,

@@ -564,6 +564,44 @@ class Config:
     # non-ASCII documents — results are identical either way.
     native_ingest: bool = True
 
+    # --- compute-plane chaos + degradation (ISSUE 20) ---
+    # Gate on the /api/device-nemesis runtime-control endpoint (the
+    # scriptable device-fault injector at the JAX dispatch seams,
+    # utils/device_nemesis.py). The TFIDF_DEVICE_NEMESIS env var arms
+    # rules regardless of this knob — this only exposes the HTTP
+    # control surface, which production deployments keep off. Named
+    # *_api so the env override (TFIDF_DEVICE_NEMESIS_API) can never
+    # collide with the rule-script variable.
+    device_nemesis_api: bool = False
+    # Host/numpy degraded scoring when the device faults repeatedly:
+    # exact same bits as the XLA scoring path (engine/compute_health.py
+    # mirrors the pinned-order reductions), honest latency, responses
+    # stamped X-Compute-Degraded. Off = faults surface to callers and
+    # leader failover is the only recourse.
+    compute_fallback: bool = True
+    # ComputeHealth state machine: consecutive device faults before the
+    # worker reports "degraded" (health surface only) and before it
+    # goes "sick" (device dispatch suspended; host fallback serves).
+    compute_degraded_after: int = 2
+    compute_sick_after: int = 5
+    # Seconds between single-probe device retries while sick — the
+    # recovery path back to the exact device plane.
+    compute_probe_interval_s: float = 5.0
+    # Poison-query quarantine (leader/router): a (query, plan)
+    # fingerprint is quarantined after compute faults on this many
+    # DISTINCT replicas (1 replica = possibly a sick device; 2+ = the
+    # query itself is the trigger), then answered 422 +
+    # X-Poison-Quarantined without touching workers.
+    poison_quarantine_after: int = 2
+    # Quarantine entry TTL and LRU bound — poison verdicts expire so a
+    # fixed kernel/binary gets a retry, and the table stays bounded.
+    poison_quarantine_ttl_s: float = 300.0
+    poison_quarantine_max: int = 256
+    # OOM backoff ladder floor: an alloc-OOM at batch B retries at B/2,
+    # B/4, ... but never below this (at the floor the fallback or the
+    # caller takes over) — so one huge batch degrades, not dies.
+    oom_backoff_min_batch: int = 8
+
     # --- misc ---
     log_level: str = "INFO"
     seed: int = 0

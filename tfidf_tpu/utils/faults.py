@@ -93,6 +93,18 @@ KNOWN_FAULT_POINTS: dict[str, str] = {
     "wal.fsync": "coordination WAL about to fsync appended entries",
     "wal.snapshot": "coordination snapshot about to be written "
                     "(pre-atomic-rename crash window)",
+    "device.score_ell": "ELL scoring dispatch seam (ops/ell.py "
+                        "score_ell_batch) — the device nemesis' primary "
+                        "injection point",
+    "device.score_segments": "segmented scoring dispatch seam "
+                             "(ops/ell.py score_segments_batch; hot "
+                             "pass, cold walk, and parity oracle)",
+    "device.score_coo": "COO scoring dispatch seam "
+                        "(ops/scoring.py score_coo_batch)",
+    "device.dense": "dense-plane dispatch seam (ops/dense.py "
+                    "dense_scores / packed_dense_topk)",
+    "device.upload": "tiering upload ring about to move one cold "
+                     "segment host->HBM (engine/tiering.py)",
     "ensemble.vote": "ensemble member handling a RequestVote RPC",
     "ensemble.replicate_append.*": "ensemble leader about to send "
                                    "AppendEntries/InstallSnapshot to one "

@@ -126,6 +126,11 @@ BULK_STAGES = {
     ("engine.index", "export_snapshot_arrays"),
     ("engine.segments", "export_full_state"),
     ("engine.dense", "export_arrays"),
+    # the host-fallback mirror build (ISSUE 20): fetching the snapshot
+    # arrays + device-computed per-entry impacts to host IS the
+    # operation (the mirror exists so a sick device can stop serving).
+    # Built once per snapshot, off the device serving path.
+    ("engine.compute_health", "_fetch_host"),
 }
 
 _SYNC_BUILTINS = {"float", "int", "bool"}

@@ -76,6 +76,9 @@ CONTRACT_HEADERS = frozenset({
     "X-Scatter-Degraded", "X-Deadline-Exceeded", "X-Fence-Rejected",
     "X-Fence-Epoch", "X-Shed-Reason", "Retry-After", "Connection",
     "X-Proto-Version", "X-Proto-Rejected", "X-Search-Stages",
+    # compute-plane chaos headers (wire v4, ISSUE 20)
+    "X-Compute-Degraded", "X-Compute-Fault", "X-Poison-Fingerprints",
+    "X-Poison-Quarantined",
 })
 
 _MUTATING_WORKER_PREFIXES = ("/worker/upload", "/worker/delete")

@@ -239,6 +239,14 @@ class ProtocolWitness:
                     and "X-Trace-Id" not in ex.headers:
                 out.append(f"traced worker RPC reply lost X-Trace-Id: "
                            f"{where}")
+            if ex.path == "/leader/start" and ex.status == 422 \
+                    and "X-Poison-Quarantined" not in ex.headers:
+                # the quarantine verdict (wire v4): a 422 on the read
+                # front door IS the poison refusal — a client must be
+                # able to tell it from any future 422 by the header,
+                # which also names the fingerprint to report
+                out.append(f"quarantine 422 without its "
+                           f"X-Poison-Quarantined stamp: {where}")
         missed = sorted(set(require_exercised) - self.observed_paths())
         if missed:
             out.append(f"statically-claimed contract surface never "

@@ -90,8 +90,12 @@ def _fault_sites(tree: SourceTree) -> dict[str, tuple[str, int]]:
             leaf = d.split(".")[-1]
             # `_observe` is CircuitBreaker's swallow-the-raise forwarder
             # to global_injector.check — its literal-arg call sites are
-            # fault points too (the old grep-based test missed them)
-            if not (leaf in ("fault_point", "_observe")
+            # fault points too (the old grep-based test missed them).
+            # `device_guard` is the compute-plane injector's dispatch
+            # seam (utils/device_nemesis.py): its sites register under
+            # the `device.` namespace — one registry covers both
+            # injectors, so chaos configs validate device rules too.
+            if not (leaf in ("fault_point", "_observe", "device_guard")
                     or (leaf == "check"
                         and "injector" in d.split(".")[0])):
                 continue
@@ -100,6 +104,8 @@ def _fault_sites(tree: SourceTree) -> dict[str, tuple[str, int]]:
                 continue
             text, is_prefix = got
             point = text.split("{")[0] + "*" if is_prefix else text
+            if leaf == "device_guard":
+                point = "device." + point
             out.setdefault(point, (mi.relpath, node.lineno))
     return out
 
